@@ -1,0 +1,158 @@
+"""Typed configuration for the TPU-native framework.
+
+The reference scattered configuration across three channels: argparse flags
+(`/root/reference/train.py:25-52`), a frozen dataclass (`ModelArgumments`,
+`/root/reference/constants.py:9-17`) and ambient environment variables
+(``DTYPE``/``DEVICE``, read at `/root/reference/models/model.py:39-40,153`).
+Here everything is a typed dataclass; dtype is an explicit field, and the CLI
+produces these dataclasses instead of an untyped `Namespace`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+# Special-token conventions, byte-compatible with the reference
+# (`/root/reference/constants.py:3-6`) so its tokenizer.json and token-JSON
+# files interoperate.
+BOS_TOKEN = "<BOS>"
+EOS_TOKEN = "<EOS>"
+UNK_TOKEN = "<UNK>"
+IGNORE_INDEX = -1
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+def resolve_dtype(name: str):
+    if name not in _DTYPES:
+        raise ValueError(f"Unknown dtype {name!r}; expected one of {sorted(_DTYPES)}")
+    return _DTYPES[name]
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """LLaMA-style decoder-only transformer shape.
+
+    Defaults mirror the reference's `ModelArgumments`
+    (`/root/reference/constants.py:9-17`): a ~45M-parameter model.
+    """
+
+    attn_dim: int = 512
+    ffn_dim: int = 2048
+    num_heads: int = 8
+    num_layers: int = 12
+    vocab_size: int = 1024
+    maxlen: int = 1000
+    rope_theta: float = 10000.0
+    # Dtype used for matmuls/activations inside the forward pass. Parameters
+    # and the loss always stay float32 (the reference's autocast semantics:
+    # `/root/reference/train.py:99-104`).
+    compute_dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        assert self.attn_dim % self.num_heads == 0
+        return self.attn_dim // self.num_heads
+
+    def padded_vocab_size(self, tp_size: int) -> int:
+        """Vocab size rounded up to a multiple of tp_size.
+
+        The reference handles non-divisible vocabs by giving the LAST rank a
+        ragged partition (`/root/reference/models/layers.py:126-131`). Ragged
+        shards are hostile to SPMD/XLA, so we instead pad the vocab dimension
+        and mask the padded logits to -inf (see models/transformer.py).
+        """
+        return ((self.vocab_size + tp_size - 1) // tp_size) * tp_size
+
+    def num_params(self) -> int:
+        d, f, v, L = self.attn_dim, self.ffn_dim, self.vocab_size, self.num_layers
+        attn = 4 * d * d + 4 * d                 # wq/wk/wv/wo weights + biases
+        ffn = 3 * d * f + 2 * f + d              # gate/up/down weights + biases
+        norms = 2 * d
+        return v * d + L * (attn + ffn + norms) + d + v * d + v  # emb + layers + final norm + lm_head
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """2-D device mesh: ('dp', 'tp').
+
+    The reference supports exactly one axis (TP == world size, asserted at
+    `/root/reference/process_manager.py:13`). We design for >=2 axes from day
+    one per BASELINE.json config 5 (TPxDP 4x2).
+    """
+
+    dp: int = 1
+    tp: int = 1
+
+    @property
+    def world_size(self) -> int:
+        return self.dp * self.tp
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Adam + OneCycle, matching the reference's
+    `optim.Adam` + `OneCycleLR` setup (`/root/reference/train.py:83-84`),
+    including torch's OneCycle defaults (div_factor=25, final_div_factor=1e4,
+    cosine annealing, and beta1 cycling between 0.85 and 0.95)."""
+
+    lr: float = 3e-4
+    warmup_steps: int = 2000
+    max_steps: int = 20000
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    # OneCycle details (torch defaults)
+    div_factor: float = 25.0
+    final_div_factor: float = 1e4
+    cycle_momentum: bool = True
+    base_momentum: float = 0.85
+    max_momentum: float = 0.95
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    data_path: str = ""
+    save_dir: str = "./checkpoints"
+    batch_size: int = 32
+    max_steps: int = 20000
+    log_interval: int = 100
+    save_interval: int = 1000
+    reserve_last_n_ckpts: int = -1
+    bf16: bool = False
+    seed: int = 0
+    # Fixed-shape padding length for XLA (reference pads to per-batch max,
+    # `/root/reference/dataset.py:41` — dynamic shapes would recompile under
+    # jit, so we pad to model maxlen; CE ignore-index masking keeps the loss
+    # identical).
+    pad_to: Optional[int] = None
+    # 'vocab_parallel' computes the CE loss on sharded logits (no all-gather
+    # of the (b, t, vocab) tensor); 'gather' materialises full logits first,
+    # matching the reference's lm_head gather_output=True data path
+    # (`/root/reference/models/model.py:137`). Both are numerically equal.
+    loss_mode: str = "vocab_parallel"
+    # Resume from the latest checkpoint in save_dir (the reference cannot
+    # resume training at all — save-only, `/root/reference/train.py:121-133`).
+    resume: bool = False
+
+
+@dataclass(frozen=True)
+class EvalConfig:
+    data_path: str = ""
+    tokenizer_path: str = ""
+    ckpt_dir: str = ""
+    max_decode_len: int = 128
+    batch_size: int = 1
+    seed: int = 0
+    bf16: bool = True
+
+
+def replace(cfg, **kw):
+    return dataclasses.replace(cfg, **kw)

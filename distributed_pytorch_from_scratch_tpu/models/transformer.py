@@ -1,0 +1,313 @@
+"""Tensor-parallel LLaMA-style decoder-only transformer (RoPE + RMSNorm +
+SwiGLU), TPU-native.
+
+Capability parity with `/root/reference/models/model.py` (Transformer /
+DecoderLayer / Attention / FFN), re-designed for XLA:
+
+* **Per-shard forward** written for `jax.shard_map` over a ('dp', 'tp') mesh;
+  the Megatron fused pattern is preserved exactly — wq/wk/wv are
+  column-parallel with `gather_output=False`, wo is row-parallel with
+  `split_input=False` (`model.py:57-60`), and likewise gate/up/down for the
+  SwiGLU FFN (`model.py:85-87`), giving one all-reduce per sublayer forward
+  and one per sublayer backward.
+
+* **Stacked layer params + `lax.scan`** instead of a Python module list
+  (`model.py:132-135`): one compiled layer body regardless of depth — faster
+  compiles, identical math.
+
+* **One shared RoPE table** instead of one per layer (`model.py:110` keeps 12
+  identical copies — SURVEY quirk #10).
+
+* **Full-vocab logits without an explicit gather**: the per-shard forward
+  returns the local vocab shard of the logits and the shard_map out-spec
+  P('dp', None, 'tp') stitches the global array — the "gather" is the output
+  sharding itself. The reference instead all-gathers inside lm_head
+  (`model.py:137`); that data path is still available via `loss_mode='gather'`
+  (see `loss_shard`), and the comm op is `ops.collectives.gather_from`.
+
+* The vanilla twin the reference's full-model test imports but never shipped
+  (`VallinaTransformer`, SURVEY quirk #1) exists here: `models/vanilla.py`.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import IGNORE_INDEX, ModelConfig, resolve_dtype
+from ..ops.attention import causal_attention
+from ..ops.collectives import gather_from, reduce_from
+from ..ops.rope import apply_rotary, rope_tables
+from ..parallel.embedding import VocabParallelEmbedding
+from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
+from ..parallel.norm import RMSNorm
+from ..runtime.prng import fold
+
+Params = Dict[str, Any]
+
+NEG_INF = -1e9  # mask value for padded vocab logits
+
+
+@dataclass(frozen=True)
+class Transformer:
+    """Static model definition; params live in an explicit pytree."""
+
+    cfg: ModelConfig
+    tp_size: int = 1
+    attn_impl: str = "xla"
+    # Rematerialise each decoder layer in the backward pass instead of saving
+    # its activations (the naive O(T^2) attention otherwise stores
+    # (L, b, heads, t, t) softmax residuals — 11.7 GiB for the reference's
+    # 45M config at b=32, t=1000, which OOMs a 16G v5e chip). Trading these
+    # HBM residuals for recompute FLOPs is the standard TPU playbook
+    # (SURVEY §0 / scaling-book); the reference has no analogue (PyTorch
+    # keeps all residuals and simply needs a bigger GPU).
+    remat: bool = True
+
+    def __post_init__(self):
+        cfg, tp = self.cfg, self.tp_size
+        if cfg.num_heads % tp != 0:
+            raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp_size {tp}")
+        if cfg.attn_dim % tp != 0 or cfg.ffn_dim % tp != 0:
+            raise ValueError(
+                f"attn_dim {cfg.attn_dim} and ffn_dim {cfg.ffn_dim} must be "
+                f"divisible by tp_size {tp}")
+
+    # ---- sub-module definitions (static, cheap to rebuild) ----
+
+    @property
+    def d(self) -> int:
+        return self.cfg.attn_dim
+
+    @property
+    def vocab_padded(self) -> int:
+        return self.cfg.padded_vocab_size(self.tp_size)
+
+    @property
+    def num_local_heads(self) -> int:
+        assert self.cfg.num_heads % self.tp_size == 0, (
+            f"num_heads {self.cfg.num_heads} not divisible by tp {self.tp_size}")
+        return self.cfg.num_heads // self.tp_size
+
+    @functools.cached_property
+    def embedding(self) -> VocabParallelEmbedding:
+        return VocabParallelEmbedding(self.cfg.vocab_size, self.d, tp_size=self.tp_size)
+
+    @functools.cached_property
+    def _mods(self) -> Dict[str, Any]:
+        d, f = self.d, self.cfg.ffn_dim
+        return {
+            "wq": ColumnParallelLinear(d, d, gather_output=False),
+            "wk": ColumnParallelLinear(d, d, gather_output=False),
+            "wv": ColumnParallelLinear(d, d, gather_output=False),
+            "wo": RowParallelLinear(d, d, split_input=False),
+            "gate_proj": ColumnParallelLinear(d, f, gather_output=False),
+            "up_proj": ColumnParallelLinear(d, f, gather_output=False),
+            "down_proj": RowParallelLinear(f, d, split_input=False),
+            "norm1": RMSNorm(d),
+            "norm2": RMSNorm(d),
+        }
+
+    @functools.cached_property
+    def final_norm(self) -> RMSNorm:
+        return RMSNorm(self.d)
+
+    @functools.cached_property
+    def lm_head(self) -> ColumnParallelLinear:
+        # gather_output handled at the shard_map boundary; see module docstring.
+        return ColumnParallelLinear(self.d, self.vocab_padded, gather_output=False)
+
+    # ---- init ----
+
+    def init(self, key: jax.Array) -> Params:
+        """Full (global) parameter pytree, float32.
+
+        Layer params are stacked along a leading num_layers axis for scan.
+        """
+        L = self.cfg.num_layers
+        layer_keys = jax.random.split(fold(key, "layers"), L)
+
+        def one_layer(k: jax.Array) -> Params:
+            return {name: mod.init(fold(k, name)) for name, mod in self._mods.items()}
+
+        layers = jax.vmap(one_layer)(layer_keys)
+        lm_head = self.lm_head.init(fold(key, "lm_head"))
+        if self.vocab_padded != self.cfg.vocab_size:
+            # zero the padded output columns so checkpoints stay
+            # permutation-stable; padded logits are masked to NEG_INF anyway.
+            w = lm_head["weight"]
+            mask = (jnp.arange(self.vocab_padded) < self.cfg.vocab_size)[None, :]
+            lm_head["weight"] = jnp.where(mask, w, 0.0)
+            if "bias" in lm_head:
+                lm_head["bias"] = jnp.where(mask[0], lm_head["bias"], 0.0)
+        return {
+            "embedding": self.embedding.init(fold(key, "embedding")),
+            "layers": layers,
+            "norm": self.final_norm.init(fold(key, "norm")),
+            "lm_head": lm_head,
+        }
+
+    def specs(self) -> Params:
+        """PartitionSpec pytree matching `init`'s structure."""
+        def stack(spec_dict: Params) -> Params:
+            # prepend None for the stacked num_layers axis
+            return jax.tree.map(lambda s: P(None, *s), spec_dict,
+                                is_leaf=lambda x: isinstance(x, P))
+        return {
+            "embedding": self.embedding.specs(),
+            "layers": {name: stack(mod.specs()) for name, mod in self._mods.items()},
+            "norm": self.final_norm.specs(),
+            "lm_head": self.lm_head.specs(),
+        }
+
+    def shardings(self, mesh: Mesh) -> Params:
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), self.specs(),
+                            is_leaf=lambda x: isinstance(x, P))
+
+    # ---- per-shard forward (call inside shard_map) ----
+
+    def _layer_body(self, x: jax.Array, layer_params: Params,
+                    cos: jax.Array, sin: jax.Array, dtype) -> jax.Array:
+        m = self._mods
+        b, t, _ = x.shape
+        h = self.cfg.head_dim
+
+        # Attention sublayer: x + attn(norm1(x))   (model.py:119)
+        y = m["norm1"].apply(layer_params["norm1"], x)
+        q = m["wq"].apply(layer_params["wq"], y, dtype)
+        k = m["wk"].apply(layer_params["wk"], y, dtype)
+        v = m["wv"].apply(layer_params["wv"], y, dtype)
+        # (b, t, local_heads*h) -> (b, local_heads, t, h)
+        split_heads = lambda z: z.reshape(b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
+        q, k, v = split_heads(q), split_heads(k), split_heads(v)
+        q, k = apply_rotary(q, k, cos, sin)
+        o = causal_attention(q, k, v, impl=self.attn_impl)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, self.num_local_heads * h)
+        x = x + m["wo"].apply(layer_params["wo"], o, dtype)
+
+        # FFN sublayer: x + down(silu(gate(x)) * up(x))   (model.py:94-95,120)
+        y = m["norm2"].apply(layer_params["norm2"], x)
+        g = m["gate_proj"].apply(layer_params["gate_proj"], y, dtype)
+        u = m["up_proj"].apply(layer_params["up_proj"], y, dtype)
+        x = x + m["down_proj"].apply(layer_params["down_proj"], jax.nn.silu(g) * u, dtype)
+        return x
+
+    def forward_shard(self, params: Params, input_ids: jax.Array,
+                      position_ids: jax.Array) -> jax.Array:
+        """(b_local, t) ids -> (b_local, t, vocab_padded / tp) LOCAL logits.
+
+        Runs per-shard inside shard_map. The caller chooses whether to stitch
+        (out_spec P('dp', None, 'tp')) or explicitly `gather_from` the result.
+        """
+        dtype = resolve_dtype(self.cfg.compute_dtype)
+        x = self.embedding.apply(params["embedding"], input_ids)
+        x = x.astype(dtype)  # explicit cast, mirrors model.py:153-154
+
+        cos_t, sin_t = rope_tables(self.cfg.maxlen, self.cfg.head_dim,
+                                   self.cfg.rope_theta)
+        # mode="clip": out-of-range positions clamp to the last table row
+        # instead of jnp.take's default NaN fill (the reference would index
+        # out of bounds, model.py:117-118).
+        cos = jnp.take(cos_t, position_ids, axis=0, mode="clip")  # (b, t, head_dim)
+        sin = jnp.take(sin_t, position_ids, axis=0, mode="clip")
+
+        layer_fn = self._layer_body
+        if self.remat:
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
+
+        def body(carry, layer_params):
+            return layer_fn(carry, layer_params, cos, sin, dtype), None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = self.final_norm.apply(params["norm"], x)
+        logits = self.lm_head.apply(params["lm_head"], x, dtype)
+
+        # Mask padded vocab entries so they carry no probability mass.
+        if self.vocab_padded != self.cfg.vocab_size:
+            local_v = self.vocab_padded // self.tp_size
+            start = lax.axis_index("tp") * local_v
+            col = start + jnp.arange(local_v)
+            logits = jnp.where(col[None, None, :] < self.cfg.vocab_size,
+                               logits, jnp.asarray(NEG_INF, logits.dtype))
+        return logits
+
+    # ---- losses (per-shard, inside shard_map) ----
+
+    def loss_shard(self, params: Params, input_ids: jax.Array,
+                   target_ids: jax.Array, position_ids: jax.Array,
+                   mode: str = "vocab_parallel",
+                   dp_axis: str = "dp") -> jax.Array:
+        """Mean cross-entropy over non-ignored tokens, global over ('dp','tp').
+
+        f32 loss with ignore-index masking, matching the reference's
+        `F.cross_entropy(logits.float(), ..., ignore_index=-1, 'mean')`
+        (`/root/reference/train.py:101-104`).
+        """
+        logits = self.forward_shard(params, input_ids, position_ids)
+        logits = logits.astype(jnp.float32)
+        valid = target_ids != IGNORE_INDEX
+        tgt = jnp.where(valid, target_ids, 0)
+
+        if mode == "gather":
+            # Reference data path: materialise full logits (lm_head
+            # gather_output=True, model.py:137), CE on every shard, then
+            # average the tp-identical copies so the result is tp-invariant.
+            full = gather_from(logits, "tp")
+            lse = jax.nn.logsumexp(full, axis=-1)
+            tgt_logit = jnp.take_along_axis(full, tgt[..., None], axis=-1)[..., 0]
+            # average the tp-identical copies: makes the value tp-invariant
+            token_loss = reduce_from(lse - tgt_logit, "tp") / self.tp_size
+        elif mode == "vocab_parallel":
+            # Megatron-style vocab-parallel CE: never materialise the full
+            # (b, t, vocab) tensor — two scalar-field psums instead of an
+            # all-gather. Wins when vocab is large (BASELINE config 4).
+            local_v = logits.shape[-1]
+            start = lax.axis_index("tp") * local_v
+            # softmax is shift-invariant, so the max subtraction carries no
+            # gradient (and pmax has no differentiation rule anyway).
+            local_max = jnp.max(lax.stop_gradient(logits), axis=-1)
+            gmax = lax.stop_gradient(lax.pmax(local_max, "tp"))
+            sumexp = reduce_from(
+                jnp.sum(jnp.exp(logits - gmax[..., None]), axis=-1), "tp")
+            lse = jnp.log(sumexp) + gmax
+            local_tgt = tgt - start
+            owned = (local_tgt >= 0) & (local_tgt < local_v)
+            safe_tgt = jnp.where(owned, local_tgt, 0)
+            tgt_logit = jnp.take_along_axis(logits, safe_tgt[..., None], axis=-1)[..., 0]
+            tgt_logit = reduce_from(jnp.where(owned, tgt_logit, 0.0), "tp")
+            token_loss = lse - tgt_logit
+        else:
+            raise ValueError(f"unknown loss mode {mode!r}")
+
+        loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
+        count = jnp.sum(valid.astype(jnp.float32))
+        loss_sum = lax.psum(loss_sum, dp_axis)
+        count = lax.psum(count, dp_axis)
+        return loss_sum / jnp.maximum(count, 1.0)
+
+    # ---- global (jitted) entry points ----
+
+    def make_forward(self, mesh: Mesh):
+        """Jitted global forward: (params, input_ids, position_ids) -> full
+        logits (b, t, vocab_padded), vocab dim sharded over 'tp'."""
+        fwd = jax.shard_map(
+            self.forward_shard, mesh=mesh,
+            in_specs=(self.specs(), P("dp", None), P("dp", None)),
+            out_specs=P("dp", None, "tp"),
+        )
+        return jax.jit(fwd)
+
+    def make_loss(self, mesh: Mesh, mode: str = "vocab_parallel"):
+        loss = functools.partial(self.loss_shard, mode=mode)
+        fn = jax.shard_map(
+            loss, mesh=mesh,
+            in_specs=(self.specs(), P("dp", None), P("dp", None), P("dp", None)),
+            out_specs=P(),
+        )
+        return jax.jit(fn)

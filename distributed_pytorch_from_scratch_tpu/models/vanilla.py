@@ -1,0 +1,101 @@
+"""Vanilla (unsharded) transformer twin — the numerical-equivalence oracle.
+
+The reference's full-model test imports a `VallinaTransformer` that does not
+exist in its snapshot (`/root/reference/tests/test_transformers.py:14`,
+SURVEY quirk #1); this module is that missing twin, done right: a completely
+independent single-device implementation (no parallel layers, no collectives,
+no shard_map) that consumes the SAME parameter pytree `Transformer.init`
+produces. Equivalence tests train both on identical params/batches and assert
+matching losses/gradients over many steps.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import IGNORE_INDEX, ModelConfig, resolve_dtype
+from ..ops.rope import apply_rotary, rope_tables
+
+Params = Dict[str, Any]
+
+
+def _rms_norm(scale: jax.Array, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    normed = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return scale.astype(x.dtype) * normed.astype(x.dtype)
+
+
+def _linear(p: Params, x: jax.Array, dtype) -> jax.Array:
+    y = x.astype(dtype) @ p["weight"].astype(dtype)
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
+@dataclass(frozen=True)
+class VanillaTransformer:
+    cfg: ModelConfig
+
+    def forward(self, params: Params, input_ids: jax.Array,
+                position_ids: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dtype = resolve_dtype(cfg.compute_dtype)
+        h = cfg.head_dim
+
+        emb = params["embedding"]["weight"]  # (vocab_padded, d); padded rows unused
+        x = jnp.take(emb, input_ids, axis=0).astype(dtype)
+
+        cos_t, sin_t = rope_tables(cfg.maxlen, h, cfg.rope_theta)
+        cos = jnp.take(cos_t, position_ids, axis=0, mode="clip")
+        sin = jnp.take(sin_t, position_ids, axis=0, mode="clip")
+
+        def body(x, lp):
+            b, t, d = x.shape
+            y = _rms_norm(lp["norm1"]["scale"], x)
+            q = _linear(lp["wq"], y, dtype)
+            k = _linear(lp["wk"], y, dtype)
+            v = _linear(lp["wv"], y, dtype)
+            split = lambda z: z.reshape(b, t, cfg.num_heads, h).transpose(0, 2, 1, 3)
+            q, k, v = split(q), split(k), split(v)
+            q, k = apply_rotary(q, k, cos, sin)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(h)
+            mask = jnp.triu(jnp.ones((t, t), dtype=bool), k=1)
+            scores = jnp.where(mask[None, None], jnp.asarray(-10000.0, scores.dtype), scores)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
+            x = x + _linear(lp["wo"], o, dtype)
+
+            y = _rms_norm(lp["norm2"]["scale"], x)
+            g = _linear(lp["gate_proj"], y, dtype)
+            u = _linear(lp["up_proj"], y, dtype)
+            x = x + _linear(lp["down_proj"], jax.nn.silu(g) * u, dtype)
+            return x, None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = _rms_norm(params["norm"]["scale"], x)
+        logits = _linear(params["lm_head"], x, dtype)
+        vocab_padded = logits.shape[-1]
+        if vocab_padded != cfg.vocab_size:
+            col = jnp.arange(vocab_padded)
+            logits = jnp.where(col[None, None, :] < cfg.vocab_size, logits,
+                               jnp.asarray(-1e9, logits.dtype))
+        return logits
+
+    def loss(self, params: Params, input_ids: jax.Array, target_ids: jax.Array,
+             position_ids: jax.Array) -> jax.Array:
+        logits = self.forward(params, input_ids, position_ids).astype(jnp.float32)
+        valid = target_ids != IGNORE_INDEX
+        tgt = jnp.where(valid, target_ids, 0)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        token_loss = lse - tgt_logit
+        loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
+        count = jnp.sum(valid.astype(jnp.float32))
+        return loss_sum / jnp.maximum(count, 1.0)

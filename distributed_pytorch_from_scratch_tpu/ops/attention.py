@@ -1,0 +1,44 @@
+"""Causal self-attention kernels.
+
+`causal_attention_xla` mirrors the reference's naive O(T^2) attention
+(`/root/reference/models/model.py:73-77`): explicit q@k^T / sqrt(d), additive
+-10000 causal mask, softmax, @v — but functionally (no in-place
+`masked_fill_`) and with the softmax in f32 (torch autocast computes softmax
+in f32 as well). A Pallas flash-attention kernel (`impl='flash'`) provides the
+fused HBM-friendly path the reference lacks; both produce the same math.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+MASK_VALUE = -10000.0  # reference uses -10000., model.py:75
+
+
+def causal_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """q, k, v: (b, heads, t, head_dim) -> (b, heads, t, head_dim)."""
+    *_, t, head_dim = q.shape
+    scale = 1.0 / math.sqrt(head_dim)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    mask = jnp.triu(jnp.ones((t, t), dtype=bool), k=1)
+    scores = jnp.where(mask[None, None], jnp.asarray(MASK_VALUE, scores.dtype), scores)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "xla") -> jax.Array:
+    if impl == "xla":
+        return causal_attention_xla(q, k, v)
+    if impl == "flash":
+        try:
+            from .pallas.flash_attention import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "the Pallas flash-attention kernel is not available in this "
+                "build; use impl='xla'") from e
+        return flash_attention(q, k, v)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,124 @@
+"""The four conjugate communication primitives, TPU-native.
+
+These re-express the reference's `torch.autograd.Function` collectives
+(`/root/reference/models/comm_ops.py`) over a named mesh axis, for use inside
+`jax.shard_map`-partitioned code. The conjugate-pair structure (Megatron's
+f/g operators) maps directly onto JAX primitives whose transposes are already
+the right thing:
+
+  reference op                      JAX primitive          transpose
+  ------------------------------    -------------------    -------------------
+  Copy    (fwd id, bwd all-reduce,  lax.pvary              lax.psum
+           comm_ops.py:47-60)
+  Reduce  (fwd all-reduce, bwd id,  lax.psum               lax.pvary
+           comm_ops.py:31-44)
+  Split   (fwd slice, bwd gather,   slice at axis_index    zero-pad + psum
+           comm_ops.py:7-28)                                (== all-gather)
+  Gather  (fwd all-gather, bwd      lax.all_gather         lax.psum_scatter
+           slice, comm_ops.py:63-83)                        (== slice when the
+                                                            cotangent is the
+                                                            1/n-scaled mean)
+
+so no custom VJPs are needed: JAX's vma (varying-manual-axes) machinery
+derives exactly the Megatron conjugate gradients.
+
+Unlike the reference, the ops do NOT short-circuit when the axis has size 1
+(its `tp_size == 1` early-outs, `comm_ops.py:13-14,37-38,57-58,70-71`):
+XLA compiles size-1 collectives to nothing, and the vma type system needs the
+ops to run so values keep consistent varying/invariant tags on every mesh
+shape (a size-1 'tp' axis otherwise leaves stale varying-over-tp tags that
+break out_specs replication checks).
+
+All ops MUST be called from inside `shard_map` code partitioned over `axis`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _axis_size(axis: str) -> int:
+    return lax.axis_size(axis)
+
+
+def copy_to(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """Identity forward; all-reduce(SUM) backward.
+
+    Megatron's f operator — placed at the input of a column-parallel block so
+    each shard's input-gradient contributions are summed
+    (reference `Copy`, `/root/reference/models/comm_ops.py:47-60`).
+    """
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis, to="varying")
+    return lax.pvary(x, axis)
+
+
+def reduce_from(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """All-reduce(SUM) forward; identity backward.
+
+    Megatron's g operator — sums partial outputs of a row-parallel block
+    (reference `Reduce`, `/root/reference/models/comm_ops.py:31-44`).
+    """
+    return lax.psum(x, axis)
+
+
+def split_to(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """Slice the last dim to this shard's chunk forward; all-gather backward.
+
+    (reference `Split`, `/root/reference/models/comm_ops.py:7-28`.)
+    `x` must be replicated over `axis`; the transpose of the slice under
+    shard_map reassembles the full cotangent, which is exactly the
+    all-gather-and-concat the reference's `Split.backward` performs.
+    """
+    n = _axis_size(axis)
+    dim = x.shape[-1]
+    assert dim % n == 0, f"last dim {dim} not divisible by axis size {n}"
+    shard = dim // n
+    idx = lax.axis_index(axis)
+    return lax.dynamic_slice_in_dim(x, idx * shard, shard, axis=-1)
+
+
+def gather_from(x: jax.Array, axis: str = "tp", tiled_axis: int = -1) -> jax.Array:
+    """All-gather shards along the last dim forward; slice backward.
+
+    (reference `Gather`, `/root/reference/models/comm_ops.py:63-83`.)
+    The JAX transpose is psum_scatter, which generalises the reference's
+    slice-the-grad rule: when every shard holds an identical (replicated)
+    cotangent scaled by 1/n — the situation the reference relies on, since
+    each rank computes the same loss from the same gathered logits —
+    psum_scatter reproduces the sliced gradient.
+    """
+    return lax.all_gather(x, axis, axis=tiled_axis, tiled=True)
+
+
+def reduce_scatter(x: jax.Array, axis: str = "tp", scatter_axis: int = -1) -> jax.Array:
+    """Sum across the axis, scattering the result (each shard keeps a chunk).
+
+    Absent from the reference (NCCL reduce-scatter unused) but required for
+    sequence-parallel and ZeRO-style extensions — SURVEY §5.8.
+    """
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis % x.ndim,
+                            tiled=True)
+
+
+def all_to_all(x: jax.Array, axis: str, split_axis: int, concat_axis: int) -> jax.Array:
+    """All-to-all: re-shard from one tensor dim to another over `axis`.
+
+    The Ulysses sequence-parallel primitive (head<->sequence swap); no
+    reference counterpart (SURVEY §2.4: Ulysses absent).
+    """
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def ring_permute(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
+    """Send to the next rank around the ring (ring/context parallelism)."""
+    n = _axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str = "tp") -> jax.Array:
+    return lax.axis_index(axis)

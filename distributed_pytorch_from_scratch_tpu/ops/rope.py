@@ -1,0 +1,50 @@
+"""Rotary position embeddings (RoPE).
+
+Math matches the reference's HF-style implementation
+(`/root/reference/models/model.py:17-46`): half-rotation layout, frequency
+tables of shape (maxlen, head_dim) built as `repeat(theta, 2)`. Two deliberate
+deviations from the reference:
+
+* tables are computed once and shared by all layers (the reference rebuilds
+  identical tables per DecoderLayer — 12 copies in device memory,
+  `/root/reference/models/model.py:110`, SURVEY quirk #10);
+* there is no CPU-vs-GPU split of the computation (the reference split it to
+  bit-match HF transformers on CUDA, `model.py:37-43`); everything is f32 and
+  the cast to compute dtype happens at application time.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_tables(maxlen: int, head_dim: int, base: float = 10000.0) -> Tuple[jax.Array, jax.Array]:
+    """Precompute (cos, sin), each (maxlen, head_dim), float32."""
+    assert head_dim % 2 == 0
+    theta = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(maxlen, dtype=jnp.float32)[:, None]  # (maxlen, 1)
+    ang = pos * theta[None, :]                            # (maxlen, head_dim/2)
+    ang = jnp.concatenate([ang, ang], axis=-1)            # repeat(1, 2) layout
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary(q: jax.Array, k: jax.Array, cos: jax.Array, sin: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Apply RoPE to q, k of shape (b, heads, t, head_dim).
+
+    cos/sin: (b, t, head_dim) — already indexed by position_ids, matching
+    `apply_rotary_pos_emb` (`/root/reference/models/model.py:25-31`).
+    """
+    cos = cos[:, None, :, :].astype(q.dtype)  # (b, 1, t, d)
+    sin = sin[:, None, :, :].astype(q.dtype)
+    q_rot = q * cos + rotate_half(q) * sin
+    k_rot = k * cos + rotate_half(k) * sin
+    return q_rot, k_rot

@@ -1,0 +1,66 @@
+"""Vocabulary-parallel embedding.
+
+Reference: `ParallelVocabularyEmbedding`
+(`/root/reference/models/layers.py:103-141`): each rank owns a contiguous row
+range of the embedding table, masks out-of-range ids, embeds, zeroes
+out-of-range outputs and all-reduces the partial embeddings.
+
+Two reference defects fixed here:
+
+* the reference mutates its input ids in place (`layers.py:138`, callers must
+  clone — SURVEY quirk #4). JAX is functional; we use `jnp.where`.
+* non-divisible vocabs got a ragged last-rank partition with a printed
+  warning (`layers.py:126-131`). Ragged shards break SPMD, so the table is
+  padded to `vocab_padded = ceil(vocab/n)*n` rows; padded rows are zero-init
+  and can never be indexed by a valid token id, so the math is unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.collectives import reduce_from
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class VocabParallelEmbedding:
+    vocab_size: int
+    hdim: int
+    axis: str = "tp"
+    tp_size: int = 1  # static: needed to size the padded table at init time
+
+    @property
+    def vocab_padded(self) -> int:
+        n = self.tp_size
+        return ((self.vocab_size + n - 1) // n) * n
+
+    def init(self, key: jax.Array) -> Params:
+        # normal(0, 1) like the reference (`layers.py:114`, "the same as
+        # pytorch default" for nn.Embedding).
+        w = jax.random.normal(key, (self.vocab_size, self.hdim), jnp.float32)
+        if self.vocab_padded != self.vocab_size:
+            pad = jnp.zeros((self.vocab_padded - self.vocab_size, self.hdim), jnp.float32)
+            w = jnp.concatenate([w, pad], axis=0)
+        return {"weight": w}
+
+    def specs(self) -> Params:
+        return {"weight": P(self.axis, None)}
+
+    def apply(self, params: Params, ids: jax.Array) -> jax.Array:
+        """ids: (b, t) int32 -> (b, t, hdim) float32 (full, replicated)."""
+        w = params["weight"]                      # local (vocab_padded/n, hdim)
+        rows = w.shape[0]
+        start = lax.axis_index(self.axis) * rows
+        in_range = (ids >= start) & (ids < start + rows)
+        local_ids = jnp.where(in_range, ids - start, 0)
+        out = jnp.take(w, local_ids, axis=0, mode="clip")
+        out = jnp.where(in_range[..., None], out, 0.0)
+        return reduce_from(out, self.axis)        # sum partials across shards

@@ -1,0 +1,35 @@
+"""RMSNorm — replicated (not parallel), computed in f32.
+
+Reference: `/root/reference/models/layers.py:145-155` ("Borrowed from LLama"):
+`scale * x * rsqrt(mean(x^2) + eps)`, with the normalisation in f32 and the
+result cast back to the input dtype. eps=1e-5.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class RMSNorm:
+    hdim: int
+    eps: float = 1e-5
+
+    def init(self, key: jax.Array) -> Params:
+        del key
+        return {"scale": jnp.ones((self.hdim,), jnp.float32)}
+
+    def specs(self) -> Params:
+        return {"scale": P(None)}
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (params["scale"].astype(x.dtype) * normed.astype(x.dtype))

@@ -1,0 +1,95 @@
+"""Device-mesh runtime: the TPU-native replacement for the reference's
+process-group machinery.
+
+The reference binds one OS process per GPU via `mp.spawn`
+(`/root/reference/train.py:151`), rendezvouses over TCP
+(`/root/reference/utils.py:19-24`) and keeps a module-global
+`ProcessGroupManager` singleton with the TP topology
+(`/root/reference/process_manager.py:8-25`). On TPU one process drives all
+local chips, topology is a `jax.sharding.Mesh` with named axes, and
+collectives are XLA ops over ICI — so this module is mostly a thin, typed
+factory plus multi-host init.
+
+Axis names: 'dp' (data parallel) and 'tp' (tensor parallel). The reference
+only has 'tp' (`process_manager.py:13` asserts tp_size == world_size); the
+2-D mesh is the BASELINE.json config-5 extension.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import MeshConfig
+
+DP_AXIS = "dp"
+TP_AXIS = "tp"
+AXIS_NAMES = (DP_AXIS, TP_AXIS)
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the ('dp', 'tp') mesh.
+
+    Replaces `init_pgm` (`/root/reference/process_manager.py:23-25`): where the
+    reference carved a 1-D `torch.arange(world).view(tp_size)` grid into one
+    NCCL group (`process_manager.py:16-17`), here the mesh itself is the
+    topology and XLA lowers named-axis collectives onto ICI rings.
+
+    The 'tp' axis is innermost (fastest-varying over devices) so TP
+    collectives — the per-layer latency-critical ops, see SURVEY §3.1 —
+    ride neighbouring ICI links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = cfg.dp * cfg.tp
+    if n > len(devices):
+        raise ValueError(
+            f"Mesh {cfg.dp}x{cfg.tp} needs {n} devices but only "
+            f"{len(devices)} are visible"
+        )
+    grid = np.asarray(devices[:n]).reshape(cfg.dp, cfg.tp)
+    return Mesh(grid, AXIS_NAMES, axis_types=(jax.sharding.AxisType.Auto,) * 2)
+
+
+def single_device_mesh() -> Mesh:
+    """1x1 mesh: the TP=1 degenerate case (the reference's de-facto 'vanilla'
+    path, where every comm op no-ops — `/root/reference/models/comm_ops.py:13-14`)."""
+    return make_mesh(MeshConfig(dp=1, tp=1))
+
+
+def tp_mesh(tp: int) -> Mesh:
+    return make_mesh(MeshConfig(dp=1, tp=tp))
+
+
+def mesh_shape(mesh: Mesh) -> MeshConfig:
+    return MeshConfig(dp=mesh.shape[DP_AXIS], tp=mesh.shape[TP_AXIS])
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def init_multihost(coordinator: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None) -> None:
+    """Multi-host (DCN) initialisation.
+
+    The reference's analogue is `dist.init_process_group('nccl', 'env://')`
+    (`/root/reference/utils.py:23`). For a single host this is a no-op: one
+    process sees all local chips. Across hosts, `jax.distributed.initialize`
+    wires the DCN rendezvous; afterwards `jax.devices()` spans the slice and
+    the same mesh code works unchanged.
+    """
+    if coordinator is None and "COORDINATOR_ADDRESS" in os.environ:
+        coordinator = os.environ["COORDINATOR_ADDRESS"]
+    if coordinator is None:
+        return  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,37 @@
+"""Deterministic PRNG plumbing.
+
+Replaces the reference's seed-everything + broadcast-from-rank-0 init dance:
+`set_seed` (`/root/reference/utils.py:12-16`) seeds four RNGs identically on
+every rank, then each parallel layer materialises a FULL weight, broadcasts
+rank 0's copy and slices (`/root/reference/models/layers.py:78-87`). With an
+explicit JAX PRNG key the whole dance collapses — every host derives the same
+init from the same key, and `NamedSharding` does the slicing. The *property*
+the reference's tests assert (a shard equals the slice of one full init) holds
+by construction.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def fold(key: jax.Array, name: str) -> jax.Array:
+    """Derive a named subkey. Stable: depends only on (key, name)."""
+    # Fold in a stable hash of the name (Python's hash() is salted per
+    # process, which would break cross-host determinism).
+    h = 0
+    for ch in name.encode():
+        h = (h * 131 + ch) % (2**31 - 1)
+    return jax.random.fold_in(key, h)
+
+
+def split_iter(key: jax.Array) -> Iterator[jax.Array]:
+    while True:
+        key, sub = jax.random.split(key)
+        yield sub

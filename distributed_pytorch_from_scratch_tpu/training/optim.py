@@ -1,0 +1,125 @@
+"""Adam + OneCycle LR schedule, hand-rolled (from scratch, like the rest).
+
+The reference uses `optim.Adam(params, lr)` + `OneCycleLR(optimizer, max_lr,
+total_steps, pct_start=warmup/max_steps)` (`/root/reference/train.py:83-84`).
+This module reproduces torch's semantics exactly:
+
+* Adam: bias-corrected first/second moments, eps inside the sqrt's
+  denominator, no weight decay (torch defaults, betas=(0.9, 0.999), eps=1e-8).
+* OneCycleLR (torch defaults): two cosine phases —
+  warmup  `initial_lr = max_lr/div_factor -> max_lr` over pct_start,
+  anneal  `max_lr -> initial_lr/final_div_factor` over the rest;
+  and because torch's `cycle_momentum=True` default applies to Adam via its
+  betas, **beta1 is cycled too**: max_momentum (0.95) -> base_momentum (0.85)
+  during warmup and back up during annealing. (torch overwrites Adam's 0.9
+  beta1 at scheduler construction — subtle but real, and we match it.)
+
+Equivalence against torch.optim itself is asserted in
+tests/test_optim.py (torch-CPU is available in the image for testing only;
+the framework itself never imports torch).
+
+The optimizer state pytree mirrors the param pytree, so the same
+PartitionSpecs shard it: each TP rank keeps Adam moments only for its own
+weight shard — the same property the reference gets from per-rank
+`optim.Adam(model.parameters())` (`train.py:83`).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import OptimizerConfig
+
+
+class AdamState(NamedTuple):
+    step: jax.Array      # int32 scalar
+    mu: Any              # first moment, same pytree as params
+    nu: Any              # second moment
+
+
+def init_adam_state(params: Any) -> AdamState:
+    zeros = lambda p: jnp.zeros_like(p)
+    return AdamState(
+        step=jnp.zeros((), jnp.int32),
+        mu=jax.tree.map(zeros, params),
+        nu=jax.tree.map(zeros, params),
+    )
+
+
+def _anneal_cos(start: float, end: float, pct: jax.Array) -> jax.Array:
+    return end + (start - end) / 2.0 * (1.0 + jnp.cos(jnp.pi * pct))
+
+
+def onecycle_lr(cfg: OptimizerConfig, step: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(lr, beta1) at optimizer step `step` (0-based, i.e. the schedule value
+    used by the (step+1)-th update — torch applies the initial lr at
+    construction and steps the scheduler after each optimizer.step())."""
+    total = cfg.max_steps
+    pct_start = cfg.warmup_steps / cfg.max_steps
+    # torch's phase boundaries: warmup ends at pct_start*total - 1, annealing
+    # at total - 1 (OneCycleLR._schedule_phases).
+    up_end = float(pct_start * total) - 1.0
+    down_end = float(total) - 1.0
+    initial_lr = cfg.lr / cfg.div_factor
+    min_lr = initial_lr / cfg.final_div_factor
+
+    stepf = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+    up_pct = jnp.clip(stepf / jnp.maximum(up_end, 1e-9), 0.0, 1.0)
+    down_pct = jnp.clip((stepf - up_end) / jnp.maximum(down_end - up_end, 1e-9),
+                        0.0, 1.0)
+    in_warmup = stepf <= up_end
+
+    lr = jnp.where(in_warmup,
+                   _anneal_cos(initial_lr, cfg.lr, up_pct),
+                   _anneal_cos(cfg.lr, min_lr, down_pct))
+    if cfg.cycle_momentum:
+        beta1 = jnp.where(in_warmup,
+                          _anneal_cos(cfg.max_momentum, cfg.base_momentum, up_pct),
+                          _anneal_cos(cfg.base_momentum, cfg.max_momentum, down_pct))
+    else:
+        beta1 = jnp.asarray(cfg.betas[0], jnp.float32)
+    return lr, beta1
+
+
+def adam_update(cfg: OptimizerConfig, params: Any, grads: Any,
+                state: AdamState) -> Tuple[Any, AdamState]:
+    """One Adam step with the OneCycle (lr, beta1) for this step.
+
+    Matches torch.optim.Adam's update exactly:
+        mu    <- b1*mu + (1-b1)*g
+        nu    <- b2*nu + (1-b2)*g^2
+        p     <- p - lr * (mu/(1-b1^t)) / (sqrt(nu/(1-b2^t)) + eps)
+    """
+    step = state.step  # 0-based count of completed steps
+    lr, beta1 = onecycle_lr(cfg, step)
+    beta2 = cfg.betas[1]
+    t = (step + 1).astype(jnp.float32)
+    # Bias correction with a *cycled* beta1: torch computes `1 - beta1**t`
+    # with the CURRENT beta1 (the scheduler rewrites param_groups), so we do
+    # the same.
+    bc1 = 1.0 - jnp.power(beta1, t)
+    bc2 = 1.0 - jnp.power(jnp.asarray(beta2, jnp.float32), t)
+
+    def upd(p, g, m, v):
+        g = g.astype(p.dtype)
+        m_new = beta1 * m + (1.0 - beta1) * g
+        v_new = beta2 * v + (1.0 - beta2) * (g * g)
+        m_hat = m_new / bc1
+        v_hat = v_new / bc2
+        p_new = p - lr * m_hat / (jnp.sqrt(v_hat) + cfg.eps)
+        return p_new, m_new, v_new
+
+    flat_p, treedef = jax.tree.flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_m = treedef.flatten_up_to(state.mu)
+    flat_v = treedef.flatten_up_to(state.nu)
+    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    new_p = treedef.unflatten([o[0] for o in out])
+    new_m = treedef.unflatten([o[1] for o in out])
+    new_v = treedef.unflatten([o[2] for o in out])
+    return new_p, AdamState(step=step + 1, mu=new_m, nu=new_v)

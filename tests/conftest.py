@@ -1,0 +1,32 @@
+"""Test harness: a virtual 8-device CPU mesh, no TPU required.
+
+The reference's tests spawn real NCCL processes on >=2 physical GPUs
+(`mp.spawn` in each `tests/*.py` `__main__`; SURVEY §4 — there are no
+cluster-free tests at all). JAX makes distributed testing cheap: we force the
+host platform to expose 8 virtual CPU devices and every sharding/collective
+path runs in-process. The same test code runs unchanged on real TPU chips.
+
+NOTE: this image injects an `axon` PJRT plugin via sitecustomize that forces
+the TPU platform regardless of JAX_PLATFORMS, so we must override the
+platform *after* importing jax, before any backend is initialised.
+"""
+
+import os
+
+# Must be set before the first XLA CPU client is created.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _check_devices():
+    assert jax.device_count() >= 8, (
+        f"expected 8 virtual CPU devices, got {jax.device_count()}")
+    yield

@@ -1,0 +1,178 @@
+"""Conjugate-pair tests for the four comm ops (SURVEY §7 step 2).
+
+The reference has no direct tests for `models/comm_ops.py` — its layer tests
+exercise them indirectly. Here each op's forward semantics and its
+conjugate-gradient (the forward of its pair) are asserted directly:
+
+    Copy   fwd = identity      Copy   bwd = Reduce fwd (all-reduce)
+    Reduce fwd = all-reduce    Reduce bwd = Copy   fwd (identity)
+    Split  fwd = local slice   Split  bwd = Gather fwd (all-gather)
+    Gather fwd = all-gather    Gather bwd = Split  fwd (slice)
+
+(`/root/reference/models/comm_ops.py:7-83`.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_pytorch_from_scratch_tpu.config import MeshConfig
+from distributed_pytorch_from_scratch_tpu.ops import collectives as C
+from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+
+TP = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig(dp=2, tp=TP))
+
+
+def shmap(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def test_copy_forward_identity(mesh):
+    x = jnp.arange(16.0).reshape(2, 8)
+    # per-shard output is the full replicated x; declaring the output sharded
+    # over tp stitches one copy per shard -> a horizontal tiling of x.
+    f = shmap(lambda x: C.copy_to(x, "tp"), mesh, (P(),), P(None, "tp"))
+    assert np.allclose(f(x), np.tile(np.asarray(x), (1, TP)))
+
+
+def test_copy_reduce_conjugate_grads(mesh):
+    """grad through Copy must all-reduce: d/dx sum_r f_r(copy(x)) = sum_r f_r'."""
+    x = jnp.arange(8.0)
+
+    def per_shard(x):
+        xc = C.copy_to(x, "tp")
+        # shard-dependent linear function: weight = (rank+1)
+        w = (C.axis_index("tp") + 1).astype(jnp.float32)
+        return C.reduce_from(jnp.sum(xc) * w, "tp")
+
+    f = shmap(per_shard, mesh, (P(),), P())
+    g = jax.grad(f)(x)
+    expected = sum(r + 1 for r in range(TP))  # all-reduce of per-rank grads
+    assert np.allclose(g, expected)
+
+
+def test_reduce_forward_sums(mesh):
+    x = jnp.ones((TP * 2,))
+
+    def per_shard(x_local):
+        return C.reduce_from(jnp.sum(x_local), "tp")
+
+    f = shmap(per_shard, mesh, (P("tp"),), P())
+    # x sharded over tp: each shard sums its 2 elements -> 2; psum -> 2*TP
+    assert np.allclose(f(x), 2 * TP)
+
+
+def test_reduce_backward_identity(mesh):
+    x = jnp.arange(4.0)
+
+    def per_shard(x):
+        return C.reduce_from(jnp.sum(x * x), "tp") / TP
+
+    f = shmap(per_shard, mesh, (P(),), P())
+    g = jax.grad(f)(x)
+    # loss = psum(sum(x^2))/TP = sum(x^2); grad = 2x (identity bwd, no double count)
+    assert np.allclose(g, 2 * x)
+
+
+def test_split_forward_slices(mesh):
+    x = jnp.arange(TP * 3.0).reshape(1, TP * 3)
+
+    def per_shard(x):
+        local = C.split_to(x, "tp")       # (1, 3)
+        return local
+
+    f = shmap(per_shard, mesh, (P(),), P(None, "tp"))
+    out = f(x)
+    # stitching the per-shard slices reassembles x
+    assert np.allclose(out, x)
+
+
+def test_split_backward_gathers(mesh):
+    """Split bwd must reassemble the full cotangent (reference all-gathers,
+    comm_ops.py:22-28)."""
+    x = jnp.arange(TP * 2.0)
+
+    def per_shard(x):
+        local = C.split_to(x, "tp")
+        w = (C.axis_index("tp") + 1).astype(jnp.float32)
+        return C.reduce_from(jnp.sum(local) * w, "tp")
+
+    f = shmap(per_shard, mesh, (P(),), P())
+    g = jax.grad(f)(x)
+    expected = np.repeat(np.arange(1, TP + 1, dtype=np.float32), 2)
+    assert np.allclose(g, expected)
+
+
+def test_gather_forward_concats(mesh):
+    x = jnp.arange(TP * 2.0)
+
+    def per_shard(x_local):
+        full = C.gather_from(x_local, "tp")
+        return jnp.sum(full) / 1.0  # varying-free value? keep per-shard
+    f = shmap(lambda x: C.reduce_from(jnp.sum(C.gather_from(x, "tp")), "tp") / TP,
+              mesh, (P("tp"),), P())
+    assert np.allclose(f(x), jnp.sum(x))
+
+
+def test_gather_backward_slices(mesh):
+    """Gather bwd: each shard's weight grad only sees its own slice of the
+    cotangent (reference slices, comm_ops.py:78-83; JAX transposes to
+    psum_scatter which equals the slice for the tp-mean loss)."""
+    w = jnp.arange(TP * 2.0)  # sharded over tp, 2 per shard
+
+    def per_shard(w_local):
+        full = C.gather_from(w_local, "tp")          # (TP*2,)
+        coef = jnp.arange(TP * 2.0) + 1.0            # distinct cotangent per col
+        loss = jnp.sum(full * coef)
+        return C.reduce_from(loss, "tp") / TP        # mean of identical copies
+
+    f = shmap(per_shard, mesh, (P("tp"),), P())
+    g = jax.grad(f)(w)
+    assert np.allclose(g, jnp.arange(TP * 2.0) + 1.0)
+
+
+def test_reduce_scatter_matches_reduce_then_split(mesh):
+    x = jax.random.normal(jax.random.key(0), (TP, TP * 4))
+
+    def via_rs(x_local):
+        return C.reduce_scatter(x_local, "tp", scatter_axis=-1)
+
+    def via_reduce_split(x_local):
+        return C.split_to(C.reduce_from(x_local, "tp"), "tp")
+
+    f1 = shmap(via_rs, mesh, (P("tp"),), P("tp", "tp"))
+    # note: out last dim sharded; compare summed values instead to avoid
+    # double-sharded spec complexity
+    f1 = shmap(lambda x: C.reduce_from(jnp.sum(via_rs(x)), "tp") / TP, mesh, (P("tp"),), P())
+    f2 = shmap(lambda x: C.reduce_from(jnp.sum(via_reduce_split(x)), "tp") / TP, mesh, (P("tp"),), P())
+    assert np.allclose(f1(x), f2(x), atol=1e-5)
+
+
+def test_all_to_all_roundtrip(mesh):
+    x = jax.random.normal(jax.random.key(1), (TP * 2, TP * 3))
+
+    def per_shard(x_local):  # x sharded on dim 0
+        swapped = C.all_to_all(x_local, "tp", split_axis=1, concat_axis=0)
+        back = C.all_to_all(swapped, "tp", split_axis=0, concat_axis=1)
+        return back
+
+    f = shmap(per_shard, mesh, (P("tp"),), P("tp", None))
+    assert np.allclose(f(x), x)
+
+
+def test_ring_permute(mesh):
+    x = jnp.arange(float(TP))
+
+    def per_shard(x_local):
+        return C.ring_permute(x_local, "tp", shift=1)
+
+    f = shmap(per_shard, mesh, (P("tp"),), P("tp"))
+    out = f(x)
+    assert np.allclose(out, np.roll(np.arange(float(TP)), 1))

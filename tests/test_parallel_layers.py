@@ -1,0 +1,319 @@
+"""Numerical-equivalence tests for the parallel layers.
+
+Ports the reference's testing idiom (SURVEY §4;
+`/root/reference/tests/test_column_parallel_linear.py`,
+`test_row_parallel_linear.py`, `test_parallel_vocab_embedding.py`) to JAX:
+
+1. init equality — the sharded layer's global param IS the full init (one
+   PRNG key; the reference needed an RNG save/restore + broadcast dance);
+2. forward allclose against a plain jnp oracle across a grid of shapes;
+3. gradient equality (input grads full, weight grads slice-vs-slice);
+4. multi-step training equivalence — hundreds of SGD steps on sharded vs
+   vanilla, asserting the full loss history matches (the reference runs 1000
+   steps on 2 GPUs; under jit determinism we get tighter tolerances with
+   fewer steps).
+
+All tests run on the virtual 8-device CPU mesh from conftest.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_pytorch_from_scratch_tpu.config import MeshConfig
+from distributed_pytorch_from_scratch_tpu.parallel.embedding import VocabParallelEmbedding
+from distributed_pytorch_from_scratch_tpu.parallel.linear import (
+    ColumnParallelLinear, RowParallelLinear)
+from distributed_pytorch_from_scratch_tpu.parallel.norm import RMSNorm
+from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+
+TP = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshConfig(dp=1, tp=TP))
+
+
+def run_sharded(mesh, fn, in_specs, out_specs, *args):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))(*args)
+
+
+# ---------------------------------------------------------------- column ----
+
+DIM_GRID = [(16, 32), (64, 16), (32, 32)]
+SHAPE_GRID = [(2, 8), (4, 1), (1, 16)]
+
+
+@pytest.mark.parametrize("idim,odim", DIM_GRID)
+@pytest.mark.parametrize("bias", [True, False])
+def test_column_parallel_forward_and_grads(mesh, idim, odim, bias):
+    layer = ColumnParallelLinear(idim, odim, add_bias=bias, gather_output=False)
+    key = jax.random.key(42)
+    params = layer.init(key)
+
+    for b, t in SHAPE_GRID:
+        x = jax.random.normal(jax.random.fold_in(key, b * 100 + t), (b, t, idim))
+
+        def sharded_loss(params, x):
+            y = layer.apply(params, x)                    # local (b,t,odim/n)
+            coef = jnp.arange(1.0, odim + 1.0)
+            local = jax.lax.dynamic_slice_in_dim(
+                coef, jax.lax.axis_index("tp") * (odim // TP), odim // TP)
+            s = jnp.sum(y * local)                        # distinct per column
+            return jax.lax.psum(s, "tp")
+
+        def oracle_loss(params, x):
+            y = x @ params["weight"]
+            if bias:
+                y = y + params["bias"]
+            return jnp.sum(y * jnp.arange(1.0, odim + 1.0))
+
+        in_specs = (layer.specs(), P())
+        loss = run_sharded(mesh, sharded_loss, in_specs, P(), params, x)
+        ref = oracle_loss(params, x)
+        np.testing.assert_allclose(loss, ref, rtol=1e-5)
+
+        g_sh = jax.jit(jax.grad(jax.shard_map(
+            sharded_loss, mesh=mesh, in_specs=in_specs, out_specs=P()),
+            argnums=(0, 1)))(params, x)
+        g_ref = jax.grad(oracle_loss, argnums=(0, 1))(params, x)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+                     g_sh, g_ref)
+
+
+def test_column_parallel_gather_output(mesh):
+    idim, odim = 16, 32
+    layer = ColumnParallelLinear(idim, odim, gather_output=True)
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 4, idim))
+
+    out = run_sharded(
+        mesh,
+        lambda p, x: jax.lax.psum(jnp.sum(layer.apply(p, x), axis=-1).mean(), "tp") / TP,
+        (layer.specs(), P()), P(), params, x)
+    # gathered output summed over full odim must be tp-invariant; compare to oracle
+    ref = jnp.sum(x @ params["weight"] + params["bias"], axis=-1).mean()
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+# ------------------------------------------------------------------- row ----
+
+@pytest.mark.parametrize("idim,odim", DIM_GRID)
+@pytest.mark.parametrize("bias", [True, False])
+@pytest.mark.parametrize("split_input", [True, False])
+def test_row_parallel_forward_and_grads(mesh, idim, odim, bias, split_input):
+    layer = RowParallelLinear(idim, odim, add_bias=bias, split_input=split_input)
+    key = jax.random.key(7)
+    params = layer.init(key)
+    x = jax.random.normal(jax.random.fold_in(key, 1), (2, 4, idim))
+
+    def sharded_loss(params, x):
+        if not split_input:
+            # caller supplies pre-sharded input: slice it here to simulate
+            from distributed_pytorch_from_scratch_tpu.ops.collectives import split_to
+            x = split_to(x, "tp")
+        y = layer.apply(params, x)
+        return jnp.sum(y * y) / y.size
+
+    def oracle_loss(params, x):
+        y = x @ params["weight"]
+        if bias:
+            y = y + params["bias"]
+        return jnp.sum(y * y) / y.size
+
+    in_specs = (layer.specs(), P())
+    loss = run_sharded(mesh, sharded_loss, in_specs, P(), params, x)
+    np.testing.assert_allclose(loss, oracle_loss(params, x), rtol=1e-5)
+
+    g_sh = jax.jit(jax.grad(jax.shard_map(
+        sharded_loss, mesh=mesh, in_specs=in_specs, out_specs=P()),
+        argnums=(0, 1)))(params, x)
+    g_ref = jax.grad(oracle_loss, argnums=(0, 1))(params, x)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5),
+                 g_sh, g_ref)
+
+
+# ------------------------------------------------------------- embedding ----
+
+@pytest.mark.parametrize("vocab", [64, 100, 1024])  # 100: non-divisible -> padded
+def test_vocab_parallel_embedding_forward(mesh, vocab):
+    hdim = 16
+    layer = VocabParallelEmbedding(vocab, hdim, tp_size=TP)
+    params = layer.init(jax.random.key(3))
+    ids = jax.random.randint(jax.random.key(4), (2, 10), 0, vocab)
+
+    out = run_sharded(mesh, layer.apply, (layer.specs(), P()), P(None, None, "tp"),
+                      params, ids)
+    # out stitched over a fake last-dim sharding of identical copies -> tile;
+    # take the first hdim block and compare with a plain take.
+    out = out[..., :hdim]
+    ref = jnp.take(params["weight"], ids, axis=0)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_vocab_parallel_embedding_grads(mesh):
+    vocab, hdim = 64, 8
+    layer = VocabParallelEmbedding(vocab, hdim, tp_size=TP)
+    params = layer.init(jax.random.key(5))
+    ids = jax.random.randint(jax.random.key(6), (4, 6), 0, vocab)
+
+    def sharded_loss(params, ids):
+        out = layer.apply(params, ids)
+        return jnp.sum(out * out)
+
+    def oracle_loss(params, ids):
+        out = jnp.take(params["weight"], ids, axis=0)
+        return jnp.sum(out * out)
+
+    loss = run_sharded(mesh, sharded_loss, (layer.specs(), P()), P(), params, ids)
+    np.testing.assert_allclose(loss, oracle_loss(params, ids), rtol=1e-5)
+
+    g_sh = jax.jit(jax.grad(jax.shard_map(
+        sharded_loss, mesh=mesh, in_specs=(layer.specs(), P()), out_specs=P())))(params, ids)
+    g_ref = jax.grad(oracle_loss)(params, ids)
+    np.testing.assert_allclose(g_sh["weight"], g_ref["weight"], rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_does_not_mutate_input(mesh):
+    """The reference mutates ids in place (`layers.py:138`, SURVEY quirk #4).
+    JAX arrays are immutable, but assert the contract anyway."""
+    vocab, hdim = 64, 8
+    layer = VocabParallelEmbedding(vocab, hdim, tp_size=TP)
+    params = layer.init(jax.random.key(5))
+    ids = jax.random.randint(jax.random.key(6), (2, 5), 0, vocab)
+    before = np.asarray(ids).copy()
+    run_sharded(mesh, layer.apply, (layer.specs(), P()), P(None, None, "tp"), params, ids)
+    np.testing.assert_array_equal(np.asarray(ids), before)
+
+
+# -------------------------------------------------- multi-step training -----
+
+def test_column_parallel_multi_step_training(mesh):
+    """Reference check #3 (`test_column_parallel_linear.py:111-135`): many
+    SGD steps on parallel vs vanilla; final weights AND the whole loss
+    history must match."""
+    idim, odim, steps, lr = 16, 32, 200, 1e-2
+    layer = ColumnParallelLinear(idim, odim, gather_output=False)
+    key = jax.random.key(11)
+    params_sh = layer.init(key)
+    params_ref = jax.tree.map(jnp.copy, params_sh)
+
+    def sharded_loss(params, x, y_tgt):
+        y = layer.apply(params, x)                       # local shard
+        from distributed_pytorch_from_scratch_tpu.ops.collectives import split_to
+        tgt = split_to(y_tgt, "tp")
+        local = jnp.sum((y - tgt) ** 2)
+        return jax.lax.psum(local, "tp") / y_tgt.size
+
+    def oracle_loss(params, x, y_tgt):
+        y = x @ params["weight"] + params["bias"]
+        return jnp.sum((y - y_tgt) ** 2) / y_tgt.size
+
+    sh_loss_fn = jax.jit(jax.value_and_grad(jax.shard_map(
+        sharded_loss, mesh=mesh, in_specs=(layer.specs(), P(), P()), out_specs=P())))
+    ref_loss_fn = jax.jit(jax.value_and_grad(oracle_loss))
+
+    hist_sh, hist_ref = [], []
+    for step in range(steps):
+        k = jax.random.fold_in(key, 1000 + step)
+        x = jax.random.normal(k, (4, idim))
+        y_tgt = jax.random.normal(jax.random.fold_in(k, 1), (4, odim))
+        l_sh, g_sh = sh_loss_fn(params_sh, x, y_tgt)
+        l_ref, g_ref = ref_loss_fn(params_ref, x, y_tgt)
+        params_sh = jax.tree.map(lambda p, g: p - lr * g, params_sh, g_sh)
+        params_ref = jax.tree.map(lambda p, g: p - lr * g, params_ref, g_ref)
+        hist_sh.append(float(l_sh))
+        hist_ref.append(float(l_ref))
+
+    np.testing.assert_allclose(hist_sh, hist_ref, atol=1e-5)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                 params_sh, params_ref)
+
+
+def test_row_parallel_multi_step_training(mesh):
+    idim, odim, steps, lr = 32, 16, 200, 1e-2
+    layer = RowParallelLinear(idim, odim, split_input=True)
+    key = jax.random.key(13)
+    params_sh = layer.init(key)
+    params_ref = jax.tree.map(jnp.copy, params_sh)
+
+    def sharded_loss(params, x, y_tgt):
+        y = layer.apply(params, x)
+        return jnp.sum((y - y_tgt) ** 2) / y_tgt.size
+
+    def oracle_loss(params, x, y_tgt):
+        y = x @ params["weight"] + params["bias"]
+        return jnp.sum((y - y_tgt) ** 2) / y_tgt.size
+
+    sh_loss_fn = jax.jit(jax.value_and_grad(jax.shard_map(
+        sharded_loss, mesh=mesh, in_specs=(layer.specs(), P(), P()), out_specs=P())))
+    ref_loss_fn = jax.jit(jax.value_and_grad(oracle_loss))
+
+    for step in range(steps):
+        k = jax.random.fold_in(key, 2000 + step)
+        x = jax.random.normal(k, (4, idim))
+        y_tgt = jax.random.normal(jax.random.fold_in(k, 1), (4, odim))
+        l_sh, g_sh = sh_loss_fn(params_sh, x, y_tgt)
+        l_ref, g_ref = ref_loss_fn(params_ref, x, y_tgt)
+        np.testing.assert_allclose(l_sh, l_ref, atol=1e-5)
+        params_sh = jax.tree.map(lambda p, g: p - lr * g, params_sh, g_sh)
+        params_ref = jax.tree.map(lambda p, g: p - lr * g, params_ref, g_ref)
+
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                 params_sh, params_ref)
+
+
+def test_embedding_multi_step_training(mesh):
+    """Reference `test_parallel_vocab_embedding.py:114-134`: toy model
+    (vocab-parallel embedding -> column-parallel linear), Adam-free SGD."""
+    vocab, hdim, odim, steps, lr = 64, 8, 12, 100, 1e-2
+    emb = VocabParallelEmbedding(vocab, hdim, tp_size=TP)
+    lin = ColumnParallelLinear(hdim, odim, gather_output=False)
+    key = jax.random.key(17)
+    params_sh = {"emb": emb.init(key), "lin": lin.init(jax.random.fold_in(key, 1))}
+    params_ref = jax.tree.map(jnp.copy, params_sh)
+    specs = {"emb": emb.specs(), "lin": lin.specs()}
+
+    def sharded_loss(params, ids, tgt):
+        x = emb.apply(params["emb"], ids)
+        y = lin.apply(params["lin"], x)                  # local (b,t,odim/n)
+        from distributed_pytorch_from_scratch_tpu.ops.collectives import split_to
+        t_local = split_to(tgt, "tp")
+        return jax.lax.psum(jnp.sum((y - t_local) ** 2), "tp") / tgt.size
+
+    def oracle_loss(params, ids, tgt):
+        x = jnp.take(params["emb"]["weight"], ids, axis=0)
+        y = x @ params["lin"]["weight"] + params["lin"]["bias"]
+        return jnp.sum((y - tgt) ** 2) / tgt.size
+
+    sh_fn = jax.jit(jax.value_and_grad(jax.shard_map(
+        sharded_loss, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P())))
+    ref_fn = jax.jit(jax.value_and_grad(oracle_loss))
+
+    for step in range(steps):
+        k = jax.random.fold_in(key, 3000 + step)
+        ids = jax.random.randint(k, (4, 6), 0, vocab)
+        tgt = jax.random.normal(jax.random.fold_in(k, 1), (4, 6, odim))
+        l_sh, g_sh = sh_fn(params_sh, ids, tgt)
+        l_ref, g_ref = ref_fn(params_ref, ids, tgt)
+        np.testing.assert_allclose(l_sh, l_ref, atol=1e-5)
+        params_sh = jax.tree.map(lambda p, g: p - lr * g, params_sh, g_sh)
+        params_ref = jax.tree.map(lambda p, g: p - lr * g, params_ref, g_ref)
+
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
+                 params_sh, params_ref)
+
+
+def test_rmsnorm_matches_formula():
+    layer = RMSNorm(16)
+    params = layer.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 3, 16))
+    out = layer.apply(params, x)
+    ref = x * (1.0 / np.sqrt(np.mean(np.asarray(x) ** 2, axis=-1, keepdims=True) + 1e-5))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)

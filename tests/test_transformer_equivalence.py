@@ -1,0 +1,150 @@
+"""Full-model numerical equivalence: sharded Transformer vs vanilla oracle.
+
+The working version of the reference's `tests/test_transformers.py` (which
+imports a `VallinaTransformer` that doesn't exist — SURVEY quirk #1): the
+tensor-parallel model must match the independent unsharded implementation on
+forward logits, loss, gradients, and multi-step training loss history, on
+TP-only and TPxDP meshes, in both loss modes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from distributed_pytorch_from_scratch_tpu.config import (
+    IGNORE_INDEX, MeshConfig, ModelConfig)
+from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
+from distributed_pytorch_from_scratch_tpu.models.vanilla import VanillaTransformer
+from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                  vocab_size=96, maxlen=32)
+
+
+def make_batch(key, batch=4, t=16, vocab=96):
+    k1, k2 = jax.random.split(key)
+    input_ids = jax.random.randint(k1, (batch, t), 0, vocab)
+    target_ids = jax.random.randint(k2, (batch, t), 0, vocab)
+    # sprinkle IGNORE_INDEX like padded positions
+    mask = jax.random.bernoulli(jax.random.fold_in(key, 9), 0.2, (batch, t))
+    target_ids = jnp.where(mask, IGNORE_INDEX, target_ids)
+    position_ids = jnp.tile(jnp.arange(t)[None, :], (batch, 1))
+    return input_ids, target_ids, position_ids
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 4), (2, 4), (1, 8), (2, 1)])
+def test_forward_logits_match(dp, tp):
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    model = Transformer(CFG, tp_size=tp)
+    oracle = VanillaTransformer(CFG)
+    params = model.init(jax.random.key(0))
+    ids, _, pos = make_batch(jax.random.key(1), batch=4, t=16)
+
+    logits_sh = model.make_forward(mesh)(params, ids, pos)
+    logits_ref = oracle.forward(params, ids, pos)
+    np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(logits_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("mode", ["vocab_parallel", "gather"])
+@pytest.mark.parametrize("dp,tp", [(1, 4), (2, 4)])
+def test_loss_and_grads_match(mode, dp, tp):
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    model = Transformer(CFG, tp_size=tp)
+    oracle = VanillaTransformer(CFG)
+    params = model.init(jax.random.key(0))
+    ids, tgt, pos = make_batch(jax.random.key(2))
+
+    loss_fn = model.make_loss(mesh, mode=mode)
+    l_sh, g_sh = jax.value_and_grad(loss_fn)(params, ids, tgt, pos)
+    l_ref, g_ref = jax.value_and_grad(oracle.loss)(params, ids, tgt, pos)
+
+    np.testing.assert_allclose(l_sh, l_ref, rtol=1e-5)
+    flat_sh, _ = jax.tree.flatten(g_sh)
+    flat_ref, _ = jax.tree.flatten(g_ref)
+    for a, b in zip(flat_sh, flat_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_non_divisible_vocab_padding():
+    """vocab 100 over tp 8 -> padded to 104; the reference instead gives the
+    last rank a ragged partition (`layers.py:126-131`). Losses must agree."""
+    cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=1,
+                      vocab_size=100, maxlen=16)
+    tp = 8
+    mesh = make_mesh(MeshConfig(dp=1, tp=tp))
+    model = Transformer(cfg, tp_size=tp)
+    oracle = VanillaTransformer(cfg)
+    assert model.vocab_padded == 104
+    params = model.init(jax.random.key(0))
+    ids, tgt, pos = make_batch(jax.random.key(3), batch=2, t=8, vocab=100)
+
+    for mode in ("vocab_parallel", "gather"):
+        l_sh = model.make_loss(mesh, mode=mode)(params, ids, tgt, pos)
+        l_ref = oracle.loss(params, ids, tgt, pos)
+        np.testing.assert_allclose(l_sh, l_ref, rtol=1e-5)
+
+
+def test_multi_step_training_equivalence():
+    """Reference check #3 at full-model scale: train sharded (TP=4, DP=2) and
+    vanilla side by side with SGD; loss histories and final params match."""
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))
+    model = Transformer(CFG, tp_size=4)
+    oracle = VanillaTransformer(CFG)
+    key = jax.random.key(5)
+    params_sh = model.init(key)
+    params_ref = jax.tree.map(jnp.copy, params_sh)
+    lr = 1e-2
+
+    sh_fn = jax.jit(jax.value_and_grad(model.make_loss(mesh)))
+    ref_fn = jax.jit(jax.value_and_grad(oracle.loss))
+
+    hist_sh, hist_ref = [], []
+    for step in range(50):
+        ids, tgt, pos = make_batch(jax.random.fold_in(key, step))
+        l_sh, g_sh = sh_fn(params_sh, ids, tgt, pos)
+        l_ref, g_ref = ref_fn(params_ref, ids, tgt, pos)
+        params_sh = jax.tree.map(lambda p, g: p - lr * g, params_sh, g_sh)
+        params_ref = jax.tree.map(lambda p, g: p - lr * g, params_ref, g_ref)
+        hist_sh.append(float(l_sh))
+        hist_ref.append(float(l_ref))
+
+    np.testing.assert_allclose(hist_sh, hist_ref, atol=1e-4)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-4), params_sh, params_ref)
+
+
+def test_overfit_fixed_batch():
+    """Sharded model can actually learn: overfitting one batch must drive the
+    loss down substantially."""
+    mesh = make_mesh(MeshConfig(dp=2, tp=4))
+    model = Transformer(CFG, tp_size=4)
+    params = model.init(jax.random.key(8))
+    ids, tgt, pos = make_batch(jax.random.key(9))
+    fn = jax.jit(jax.value_and_grad(model.make_loss(mesh)))
+    first = None
+    for _ in range(100):
+        loss, grads = fn(params, ids, tgt, pos)
+        if first is None:
+            first = float(loss)
+        params = jax.tree.map(lambda p, g: p - 5e-2 * g, params, grads)
+    assert float(loss) < first * 0.4, (first, float(loss))
+
+
+def test_bf16_compute_dtype_runs():
+    """bf16 path compiles and produces finite loss close to the f32 one
+    (the reference's --bf16 autocast analogue, `train.py:99-104`)."""
+    cfg_bf16 = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                           vocab_size=96, maxlen=32, compute_dtype="bfloat16")
+    mesh = make_mesh(MeshConfig(dp=1, tp=4))
+    model = Transformer(cfg_bf16, tp_size=4)
+    params = model.init(jax.random.key(0))
+    ids, tgt, pos = make_batch(jax.random.key(6))
+    loss = model.make_loss(mesh)(params, ids, tgt, pos)
+    assert np.isfinite(float(loss))
+
+    f32_loss = VanillaTransformer(CFG).loss(params, ids, tgt, pos)
+    assert abs(float(loss) - float(f32_loss)) < 0.1
