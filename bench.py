@@ -1,0 +1,132 @@
+"""Benchmark harness. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Measures training throughput of the reference-scale GPT (45M params,
+`/root/reference/constants.py:9-17`) at the reference's experiment scale
+(batch 32, seqlen 1000, bf16 — `train.py:41`, `recipe.sh`) on the available
+device(s): TP over all local chips (1 chip under the bench driver).
+
+vs_baseline: the reference publishes no numbers (BASELINE.md), so the
+driver-assigned north star is used — MFU >= 30% on TPU. vs_baseline is
+measured_MFU / 0.30 (1.0 == target met).
+
+Extra diagnostics (tp all-reduce p50 latency, MFU, memory) go to stderr.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
+                                                  Transformer, make_mesh)
+from distributed_pytorch_from_scratch_tpu.config import OptimizerConfig
+from distributed_pytorch_from_scratch_tpu.ops.collectives import reduce_from
+from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
+from distributed_pytorch_from_scratch_tpu.training.train_step import (
+    build_train_step)
+
+# Peak bf16 FLOP/s per chip by device_kind, most-specific prefix first
+# (v5p must not fall into the 'TPU v5' bucket). Used only for MFU.
+PEAK_FLOPS = [
+    ("TPU v6 lite", 918e12),   # v6e / Trillium
+    ("TPU v6", 918e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5 lite", 197e12),   # v5e
+    ("TPU v5", 197e12),
+    ("TPU v4", 275e12),
+]
+
+
+def chip_peak_flops() -> float:
+    kind = jax.devices()[0].device_kind
+    for prefix, v in PEAK_FLOPS:
+        if kind.startswith(prefix):
+            return v
+    return 197e12  # unknown: assume v5e
+
+
+def allreduce_p50_us(mesh, tp: int, nbytes: int = 4 * 1024 * 1024,
+                     iters: int = 30) -> float:
+    """TP all-reduce p50 latency over ICI (BASELINE.json metric #2)."""
+    from jax.sharding import PartitionSpec as P
+    n = nbytes // 4
+    x = jnp.ones((n,), jnp.float32)
+
+    f = jax.jit(jax.shard_map(lambda x: reduce_from(x, "tp"), mesh=mesh,
+                              in_specs=(P(),), out_specs=P()))
+    jax.block_until_ready(f(x))  # compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np_sync = f(x)[0].item()  # D2H sync (block_until_ready unreliable on axon)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1e6
+
+
+def main():
+    n_dev = jax.device_count()
+    tp = n_dev  # TP over all local chips (reference runs pure TP)
+    mesh = make_mesh(MeshConfig(dp=1, tp=tp))
+    cfg = ModelConfig(compute_dtype="bfloat16")
+    model = Transformer(cfg, tp_size=tp)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    opt_state = init_adam_state(params)
+    ocfg = OptimizerConfig()
+    step_fn = build_train_step(model, mesh, ocfg)
+
+    B, T = 32, cfg.maxlen
+    key = jax.random.key(1)
+    ids = jax.random.randint(key, (B, T), 0, cfg.vocab_size)
+    tgt = jnp.roll(ids, -1, axis=1)
+    pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (B, 1))
+
+    # NOTE: timing must sync via a device->host copy (float(loss)):
+    # block_until_ready returns early for chained donated executions on the
+    # axon platform. The first two steps are excluded — the second triggers a
+    # one-time recompile when donated output layouts replace device_put's.
+    t0 = time.time()
+    params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
+    float(loss)
+    compile_s = time.time() - t0
+
+    warm, iters = 2, 8
+    for _ in range(warm):
+        params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
+        float(loss)
+    t0 = time.time()
+    for _ in range(iters):
+        params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
+    float(loss)
+    step_s = (time.time() - t0) / iters
+
+    tokens_per_sec_per_chip = B * T / step_s / n_dev
+
+    # Model-FLOPs MFU (no remat recompute counted): 6N per token + attention
+    N = cfg.num_params()
+    L, h, hd = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    flops_per_step = 6 * N * B * T + 12 * L * B * h * T * T * hd
+    mfu = flops_per_step / step_s / (chip_peak_flops() * n_dev)
+
+    p50 = allreduce_p50_us(mesh, tp) if tp > 1 else None
+
+    print(f"bench: {n_dev} device(s) [{jax.devices()[0].device_kind}], "
+          f"compile {compile_s:.1f}s, step {step_s*1000:.1f}ms, "
+          f"loss {float(loss):.4f}, MFU {mfu*100:.1f}%"
+          + (f", tp all-reduce p50 {p50:.0f}us (4MiB)" if p50 else ""),
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": f"tokens/sec/chip (45M GPT, bf16, b{B}xt{T}, tp={tp})",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.30, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

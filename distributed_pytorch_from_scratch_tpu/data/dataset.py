@@ -1,0 +1,139 @@
+"""Token dataset + batch iterator (host-side, numpy — no torch).
+
+Replaces `/root/reference/dataset.py` (`ShakespeareDataset` + `collate_fn` +
+`get_dataloader`). Collate semantics are identical (`dataset.py:40-55`):
+
+    input_ids  = [BOS] + tokens, padded with EOS
+    target_ids = tokens + [EOS], padded with IGNORE_INDEX   (shift-by-one LM)
+    position_ids = arange
+
+One deliberate deviation for XLA: the reference pads each batch to its own
+max length (`dataset.py:41`), which under jit would recompile per batch shape.
+We pad every batch to a fixed `pad_to` length (default: model maxlen). The
+loss is unchanged — padded targets are IGNORE_INDEX and masked out of the CE
+mean — only the padding compute differs. Sequences longer than maxlen-1 are
+truncated with a warning, like the reference (`dataset.py:33-37`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..config import BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, UNK_TOKEN
+
+
+class TokenDataset:
+    """Loads the token-JSON produced by `data.tokenizer.pre_tokenize` (or the
+    reference's `pre_tokenize.py` — same schema)."""
+
+    def __init__(self, data_path: str, split: str, maxlen: int):
+        assert split in ("train", "validation"), (
+            f"expected split 'train' or 'validation', got {split!r}")
+        assert os.path.exists(data_path), f"data file not found: {data_path}"
+        with open(data_path) as f:
+            self.data = json.load(f)
+        if split not in self.data:
+            raise ValueError(
+                f"split {split!r} not in {data_path}; available: "
+                f"{list(self.data.keys())}")
+        self.split = split
+        self.maxlen = maxlen
+        self.bos: int = self.data["special_ids"][BOS_TOKEN]
+        self.eos: int = self.data["special_ids"][EOS_TOKEN]
+        self.unk: int = self.data["special_ids"][UNK_TOKEN]
+        self.vocab_size: int = self.data["vocab_size"]
+        self._warned = False
+
+    def __len__(self) -> int:
+        return len(self.data[self.split])
+
+    def __getitem__(self, idx: int) -> List[int]:
+        tokens = self.data[self.split][idx]
+        if len(tokens) > self.maxlen - 1:  # reserve one slot for BOS/EOS shift
+            if not self._warned:
+                print(f"Warning: sequence longer than maxlen-1 "
+                      f"({len(tokens)} > {self.maxlen - 1}); truncating "
+                      f"(further warnings suppressed)")
+                self._warned = True
+            tokens = tokens[: self.maxlen - 1]
+        return tokens
+
+
+def collate(batch: List[List[int]], bos: int, eos: int, ignore_idx: int,
+            pad_to: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Reference `collate_fn` (`dataset.py:40-55`) with fixed-shape padding."""
+    max_len = max(len(x) for x in batch)
+    width = (pad_to if pad_to is not None else max_len + 1)
+    assert width >= max_len + 1, f"pad_to {width} < longest sequence + 1"
+    n = len(batch)
+    input_ids = np.full((n, width), eos, dtype=np.int32)
+    target_ids = np.full((n, width), ignore_idx, dtype=np.int32)
+    for i, toks in enumerate(batch):
+        L = len(toks)
+        input_ids[i, 0] = bos
+        input_ids[i, 1 : L + 1] = toks
+        target_ids[i, :L] = toks
+        target_ids[i, L] = eos
+    position_ids = np.tile(np.arange(width, dtype=np.int32)[None, :], (n, 1))
+    return {"input_ids": input_ids, "target_ids": target_ids,
+            "position_ids": position_ids}
+
+
+@dataclass
+class DataLoader:
+    """Epoch-aware shuffling batch iterator.
+
+    Mirrors the reference's `torch.utils.data.DataLoader(shuffle=True)` use
+    (`dataset.py:58-68`) minus torch. `drop_last=True` for training keeps
+    every batch the same shape (no recompiles); the reference's final partial
+    batch is instead carried into the next epoch's order.
+    """
+
+    dataset: TokenDataset
+    batch_size: int
+    ignore_idx: int = IGNORE_INDEX
+    shuffle: bool = True
+    seed: int = 0
+    pad_to: Optional[int] = None
+    drop_last: bool = True
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else (
+            (n + self.batch_size - 1) // self.batch_size)
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + epoch).permutation(n)
+        bs = self.batch_size
+        end = n - n % bs if self.drop_last else n
+        for st in range(0, end, bs):
+            idxs = order[st : st + bs]
+            batch = [self.dataset[int(i)] for i in idxs]
+            yield collate(batch, self.dataset.bos, self.dataset.eos,
+                          self.ignore_idx, self.pad_to)
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+def get_dataloader(data_path: str, batch_size: int,
+                   ignore_idx: int = IGNORE_INDEX, split: str = "train",
+                   maxlen: int = 1000, shuffle: bool = True, seed: int = 0,
+                   pad_to: Optional[int] = None,
+                   drop_last: Optional[bool] = None) -> DataLoader:
+    """Reference-parity factory (`dataset.py:58-68`)."""
+    ds = TokenDataset(data_path, split, maxlen)
+    if pad_to is None:
+        pad_to = maxlen
+    if drop_last is None:
+        drop_last = split == "train"
+    return DataLoader(ds, batch_size, ignore_idx, shuffle, seed, pad_to,
+                      drop_last)

@@ -1,0 +1,54 @@
+"""FineWeb parquet -> {train, validation} text JSON.
+
+Replaces `/root/reference/preprocess_data.py` with the same semantics and an
+identical output schema (`{"train": [str], "validation": [str]}`), so files
+produced by either implementation interoperate:
+
+* keep texts with <= `max_chars` characters (reference filters at 2000,
+  `preprocess_data.py:27-28`);
+* shuffle with a seeded RNG;
+* split `val_ratio` (reference: 1%, `preprocess_data.py:14,31`) into
+  validation, rest into train.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+from typing import List
+
+
+def preprocess(parquet_path: str, output_path: str, max_chars: int = 2000,
+               val_ratio: float = 0.01, seed: int = 0) -> dict:
+    import pandas as pd  # host-side only
+
+    df = pd.read_parquet(parquet_path)
+    texts: List[str] = [t for t in df["text"].tolist() if len(t) <= max_chars]
+    rng = random.Random(seed)
+    rng.shuffle(texts)
+    n_val = max(1, int(len(texts) * val_ratio))
+    data = {"train": texts[n_val:], "validation": texts[:n_val]}
+    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+    with open(output_path, "w") as f:
+        json.dump(data, f)
+    print(f"preprocess: {len(data['train'])} train / {len(data['validation'])} "
+          f"validation texts -> {output_path}")
+    return data
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--parquet_path", "-i", required=True)
+    p.add_argument("--output_path", "-o", required=True)
+    p.add_argument("--max_chars", type=int, default=2000)
+    p.add_argument("--val_ratio", type=float, default=0.01)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    preprocess(args.parquet_path, args.output_path, args.max_chars,
+               args.val_ratio, args.seed)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Byte-level BPE tokenizer: training and offline pre-tokenization.
+
+Replaces `/root/reference/train_tokenizer.py` and
+`/root/reference/pre_tokenize.py`. The HF `tokenizers` Rust library is kept —
+it is host-side and TPU-agnostic (SURVEY §2.3), and keeping it means the
+reference's shipped `tokenizer/tokenizer.json` loads unchanged here and vice
+versa. Output token-JSON schema is byte-compatible with the reference
+(`pre_tokenize.py:43-48`):
+
+    {"train": [[int]], "validation": [[int]],
+     "special_ids": {"<BOS>": id, "<EOS>": id, "<UNK>": id},
+     "vocab_size": int}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Iterable, List
+
+from ..config import BOS_TOKEN, EOS_TOKEN, UNK_TOKEN
+
+
+def train_bpe(data_path: str, output_path: str, vocab_size: int = 30000,
+              split: str = "train"):
+    """Train a byte-level BPE tokenizer with BOS/EOS/UNK specials and save
+    `tokenizer.json` (reference `train_tokenizer.py:30-54`)."""
+    from tokenizers import Tokenizer
+    from tokenizers.decoders import ByteLevel as ByteLevelDecoder
+    from tokenizers.models import BPE
+    from tokenizers.pre_tokenizers import ByteLevel as ByteLevelPreTokenizer
+    from tokenizers.trainers import BpeTrainer
+
+    with open(data_path) as f:
+        texts: List[str] = json.load(f)[split]
+
+    tokenizer = Tokenizer(BPE(unk_token=UNK_TOKEN))
+    tokenizer.pre_tokenizer = ByteLevelPreTokenizer()
+    tokenizer.decoder = ByteLevelDecoder()
+    trainer = BpeTrainer(vocab_size=vocab_size,
+                         special_tokens=[BOS_TOKEN, EOS_TOKEN, UNK_TOKEN])
+    tokenizer.train_from_iterator(iter(texts), trainer=trainer)
+
+    out_dir = os.path.dirname(os.path.abspath(output_path))
+    os.makedirs(out_dir, exist_ok=True)
+    tokenizer.save(output_path)
+    print(f"tokenizer: vocab={tokenizer.get_vocab_size()} "
+          f"BOS={tokenizer.token_to_id(BOS_TOKEN)} "
+          f"EOS={tokenizer.token_to_id(EOS_TOKEN)} "
+          f"UNK={tokenizer.token_to_id(UNK_TOKEN)} -> {output_path}")
+
+    # round-trip self-check (reference train_tokenizer.py:56-67)
+    for t in ["good morning", "hello world", "this is a test"]:
+        ids = tokenizer.encode(t).ids
+        decoded = tokenizer.decode(ids).strip()
+        assert decoded == t, f"round-trip failed: {t!r} -> {decoded!r}"
+    return tokenizer
+
+
+def pre_tokenize(input_file: str, output_file: str, tokenizer_file: str,
+                 splits: Iterable[str] = ("train", "validation")) -> Dict:
+    """Apply a saved tokenizer to each split; write token-id JSON
+    (reference `pre_tokenize.py:20-52`)."""
+    from tokenizers import Tokenizer
+
+    with open(input_file) as f:
+        data = json.load(f)
+    tokenizer = Tokenizer.from_file(tokenizer_file)
+
+    out: Dict = {}
+    for split in splits:
+        encoded = tokenizer.encode_batch(data[split])
+        out[split] = [e.ids for e in encoded]
+        lens = [len(ids) for ids in out[split]] or [0]
+        print(f"pre_tokenize: {split}: n={len(out[split])} "
+              f"max={max(lens)} avg={sum(lens)/max(len(lens),1):.2f}")
+    out["special_ids"] = {
+        BOS_TOKEN: tokenizer.token_to_id(BOS_TOKEN),
+        EOS_TOKEN: tokenizer.token_to_id(EOS_TOKEN),
+        UNK_TOKEN: tokenizer.token_to_id(UNK_TOKEN),
+    }
+    out["vocab_size"] = tokenizer.get_vocab_size()
+
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    with open(output_file, "w") as f:
+        json.dump(out, f, ensure_ascii=False)
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    t = sub.add_parser("train", help="train a BPE tokenizer")
+    t.add_argument("--data_path", "-d", required=True)
+    t.add_argument("--vocab_size", "-v", type=int, default=30000)
+    t.add_argument("--output_path", "-o", required=True)
+
+    e = sub.add_parser("encode", help="pre-tokenize splits to token JSON")
+    e.add_argument("--input_file", "-i", required=True)
+    e.add_argument("--output_file", "-o", required=True)
+    e.add_argument("--tokenizer_file", "-t", required=True)
+    e.add_argument("--splits", "-s", nargs="+", default=["train", "validation"])
+
+    args = p.parse_args(argv)
+    if args.cmd == "train":
+        train_bpe(args.data_path, args.output_path, args.vocab_size)
+    else:
+        pre_tokenize(args.input_file, args.output_file, args.tokenizer_file,
+                     args.splits)
+
+
+if __name__ == "__main__":
+    main()

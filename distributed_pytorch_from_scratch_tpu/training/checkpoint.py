@@ -1,0 +1,183 @@
+"""Sharded checkpointing with the reference's filename convention + resume.
+
+The reference saves one `.pth` per TP rank, metadata encoded in the filename
+`tprank-{r}_iter-{n}_loss-{avg:.4f}.pth`, re-parsed by regex at eval time
+(`/root/reference/train.py:121-133`, `test.py:94-95`), with retention pruning
+via `--reserv_last_n_ckpts`. It never saves optimizer/step state, so training
+cannot resume (SURVEY §5.4).
+
+Here: same per-TP-shard layout and filename convention (extension `.npz`),
+each shard keyed by mesh coordinate, but the checkpoint also carries the Adam
+moments and step count so `--resume` restarts training exactly. Arrays are
+sliced/reassembled along whichever dimension the param's PartitionSpec marks
+as 'tp' — the checkpoint format is mesh-independent (save at TP=8, load at
+TP=2: the global arrays are identical).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .optim import AdamState
+
+CKPT_RE = re.compile(r"tprank-(\d+)_iter-(\d+)_loss-(.+?)\.npz$")
+
+
+def _tp_dim(spec: P) -> Optional[int]:
+    for i, axis in enumerate(spec):
+        if axis == "tp" or (isinstance(axis, tuple) and "tp" in axis):
+            return i
+    return None
+
+
+def _flatten(tree: Any, prefix: str) -> Dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = prefix + "".join(
+            f"/{p.key}" if hasattr(p, "key") else f"/{p.idx}" for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def _shard_slice(arr: np.ndarray, spec: P, rank: int, tp_size: int) -> np.ndarray:
+    dim = _tp_dim(spec)
+    if dim is None or tp_size == 1:
+        return arr
+    n = arr.shape[dim] // tp_size
+    sl = [slice(None)] * arr.ndim
+    sl[dim] = slice(rank * n, (rank + 1) * n)
+    return arr[tuple(sl)]
+
+
+def save_checkpoint(save_dir: str, step: int, avg_loss: float, params: Any,
+                    specs: Any, tp_size: int,
+                    opt_state: Optional[AdamState] = None,
+                    reserve_last_n: int = -1) -> List[str]:
+    """Write one npz per TP rank; returns the paths written."""
+    os.makedirs(save_dir, exist_ok=True)
+    params_np = jax.tree.map(np.asarray, jax.device_get(params))
+    flat_p = _flatten(params_np, "param")
+    flat_s = _flatten(specs, "param")
+    flat_opt: Dict[str, Any] = {}
+    if opt_state is not None:
+        opt_np = jax.device_get(opt_state)
+        flat_opt.update(_flatten(jax.tree.map(np.asarray, opt_np.mu), "mu"))
+        flat_opt.update(_flatten(jax.tree.map(np.asarray, opt_np.nu), "nu"))
+        # moments shard exactly like their params
+        flat_s.update({k.replace("param", "mu", 1): v for k, v in
+                       _flatten(specs, "param").items()})
+        flat_s.update({k.replace("param", "nu", 1): v for k, v in
+                       _flatten(specs, "param").items()})
+
+    paths = []
+    for rank in range(tp_size):
+        shard = {}
+        for key, arr in {**flat_p, **flat_opt}.items():
+            shard[key] = _shard_slice(np.asarray(arr), flat_s[key], rank, tp_size)
+        shard["__step__"] = np.asarray(step, np.int64)
+        shard["__tp_size__"] = np.asarray(tp_size, np.int64)
+        shard["__has_opt__"] = np.asarray(opt_state is not None)
+        path = os.path.join(save_dir,
+                            f"tprank-{rank}_iter-{step}_loss-{avg_loss:.4f}.npz")
+        np.savez(path, **shard)
+        paths.append(path)
+
+    if reserve_last_n > 0:
+        prune_checkpoints(save_dir, reserve_last_n, tp_size)
+    return paths
+
+
+def prune_checkpoints(save_dir: str, reserve_last_n: int, tp_size: int) -> None:
+    """Keep only the newest N iterations per rank
+    (reference `train.py:127-132`)."""
+    for rank in range(tp_size):
+        ckpts = glob.glob(os.path.join(save_dir, f"tprank-{rank}_iter-*_loss-*.npz"))
+        ckpts.sort(key=lambda p: int(CKPT_RE.search(os.path.basename(p)).group(2)))
+        for old in ckpts[:-reserve_last_n]:
+            os.remove(old)
+
+
+def list_checkpoints(save_dir: str, rank: int = 0) -> List[Tuple[int, str]]:
+    """(iter, path) pairs for one rank, sorted by iter
+    (reference `test.py:94-95`)."""
+    out = []
+    for p in glob.glob(os.path.join(save_dir, f"tprank-{rank}_iter-*_loss-*.npz")):
+        m = CKPT_RE.search(os.path.basename(p))
+        if m:
+            out.append((int(m.group(2)), p))
+    return sorted(out)
+
+
+def _unflatten_into(template: Any, flat: Dict[str, np.ndarray], prefix: str) -> Any:
+    paths = jax.tree_util.tree_flatten_with_path(template)[0]
+    treedef = jax.tree.structure(template)
+    leaves = []
+    for path, _ in paths:
+        key = prefix + "".join(
+            f"/{p.key}" if hasattr(p, "key") else f"/{p.idx}" for p in path)
+        leaves.append(flat[key])
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def load_checkpoint(save_dir: str, step: int, params_template: Any,
+                    specs: Any, with_opt: bool = False):
+    """Reassemble global arrays from all per-rank shards of iteration `step`.
+
+    Returns (params, opt_state | None, step).
+    """
+    rank_files = {}
+    for p in glob.glob(os.path.join(save_dir, f"tprank-*_iter-{step}_loss-*.npz")):
+        m = CKPT_RE.search(os.path.basename(p))
+        if m and int(m.group(2)) == step:
+            rank_files[int(m.group(1))] = p
+    if not rank_files:
+        raise FileNotFoundError(f"no checkpoint for iter {step} in {save_dir}")
+    any_rank = next(iter(rank_files))
+    tp_size = int(np.load(rank_files[any_rank])["__tp_size__"])
+    missing = sorted(set(range(tp_size)) - set(rank_files))
+    if missing:
+        raise FileNotFoundError(
+            f"checkpoint iter {step} was written with tp_size={tp_size} but "
+            f"shard files for rank(s) {missing} are missing from {save_dir}")
+    shards = {r: dict(np.load(rank_files[r])) for r in range(tp_size)}
+
+    flat_specs = _flatten(specs, "param")
+
+    def assemble(prefix: str) -> Dict[str, np.ndarray]:
+        out = {}
+        for key in shards[0]:
+            if not key.startswith(prefix + "/"):
+                continue
+            spec_key = "param" + key[len(prefix):]
+            dim = _tp_dim(flat_specs[spec_key])
+            if dim is None or tp_size == 1:
+                out[key] = shards[0][key]
+            else:
+                out[key] = np.concatenate(
+                    [shards[r][key] for r in range(tp_size)], axis=dim)
+        return out
+
+    params = _unflatten_into(params_template, assemble("param"), "param")
+
+    opt_state = None
+    if with_opt and bool(shards[0]["__has_opt__"]):
+        mu = _unflatten_into(params_template,
+                             {k: v for k, v in assemble("mu").items()}, "mu")
+        nu = _unflatten_into(params_template,
+                             {k: v for k, v in assemble("nu").items()}, "nu")
+        opt_state = AdamState(step=np.asarray(int(shards[0]["__step__"]),
+                                              np.int32), mu=mu, nu=nu)
+    return params, opt_state, int(shards[0]["__step__"])
+
+
+def latest_step(save_dir: str) -> Optional[int]:
+    ckpts = list_checkpoints(save_dir, rank=0)
+    return ckpts[-1][0] if ckpts else None

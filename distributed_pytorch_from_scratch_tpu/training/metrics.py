@@ -1,0 +1,60 @@
+"""Training metrics: TensorBoard scalars + JSONL fallback + device memory.
+
+Reference parity (`/root/reference/train.py:85,117-120`): `train/ce_loss`,
+`train/lr` and a per-rank reserved-memory scalar go to TensorBoard
+(`tensorboardX`). We keep tensorboardX when importable and always mirror to a
+plain `metrics.jsonl` (grep-able, no proto deps). The reference's
+`torch.cuda.memory_reserved` becomes `jax.Device.memory_stats()`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+import jax
+
+
+class MetricsWriter:
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        self._tb = None
+        try:
+            from tensorboardX import SummaryWriter  # optional
+            self._tb = SummaryWriter(log_dir=log_dir)
+        except Exception:
+            pass
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        self._jsonl.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step),
+             "ts": time.time()}) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.add_scalar(tag, value, step)
+
+    def text(self, tag: str, value: str, step: int = 0) -> None:
+        self._jsonl.write(json.dumps(
+            {"tag": tag, "text": value, "step": int(step)}) + "\n")
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.add_text(tag, value, step)
+
+    def close(self) -> None:
+        self._jsonl.close()
+        if self._tb is not None:
+            self._tb.close()
+
+
+def device_memory_gib(device: Optional[jax.Device] = None) -> float:
+    """Bytes in use on the device, in GiB (analogue of
+    `torch.cuda.memory_reserved`, reference `train.py:119`)."""
+    if device is None:
+        device = jax.devices()[0]
+    stats = getattr(device, "memory_stats", lambda: None)()
+    if not stats:
+        return 0.0
+    return stats.get("bytes_in_use", 0) / 1024 ** 3

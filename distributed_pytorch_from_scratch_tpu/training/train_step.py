@@ -1,0 +1,41 @@
+"""The jitted training step: loss + grads + Adam/OneCycle update.
+
+The TPU-native analogue of the reference's hot loop body
+(`/root/reference/train.py:94-109`): one XLA program per step — forward,
+backward (shard_map transpose inserts the conjugate collectives), optimizer
+update — with params and optimizer state donated so updates happen in-place
+in HBM (no reallocation per step; the reference relies on torch's in-place
+`optimizer.step()` for the same effect).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import OptimizerConfig, TrainConfig
+from ..models.transformer import Transformer
+from .optim import AdamState, adam_update
+
+
+def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
+                     loss_mode: str = "vocab_parallel"):
+    """Returns jitted
+    (params, opt_state, input_ids, target_ids, position_ids)
+      -> (params, opt_state, loss)."""
+    loss_fn = model.make_loss(mesh, mode=loss_mode)
+    grad_fn = jax.value_and_grad(loss_fn)
+
+    def step(params, opt_state: AdamState, input_ids, target_ids, position_ids):
+        loss, grads = grad_fn(params, input_ids, target_ids, position_ids)
+        params, opt_state = adam_update(ocfg, params, grads, opt_state)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def build_eval_loss(model: Transformer, mesh, loss_mode: str = "vocab_parallel"):
+    return jax.jit(model.make_loss(mesh, mode=loss_mode))

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# End-to-end recipe: download -> preprocess -> tokenizer -> tokenize ->
+# train (TP matrix) -> eval (TP matrix).
+#
+# TPU-native equivalent of /root/reference/recipe.sh (9 idempotent steps,
+# recipe.sh:11-125). Differences: no CUDA_VISIBLE_DEVICES/port juggling —
+# one process per host drives all chips via the ('dp','tp') mesh; the TP
+# matrix is a loop; set TP_SIZES / DP_SIZE to match your slice (e.g.
+# TP_SIZES="1 2 4 8" on a v4-8). Steps are skipped when their output exists,
+# like the reference.
+set -euo pipefail
+
+WORK=${WORK:-./work}
+VOCAB_SIZE=${VOCAB_SIZE:-1024}          # reference recipe.sh:6
+TP_SIZES=${TP_SIZES:-"1"}
+DP_SIZE=${DP_SIZE:-1}
+MAX_STEPS=${MAX_STEPS:-20000}
+BATCH_SIZE=${BATCH_SIZE:-32}
+SAVE_INTERVAL=${SAVE_INTERVAL:-1000}
+LOG_INTERVAL=${LOG_INTERVAL:-100}
+FINEWEB_URL=${FINEWEB_URL:-"https://huggingface.co/datasets/HuggingFaceFW/fineweb/resolve/main/sample/10BT/000_00000.parquet"}
+
+mkdir -p "$WORK"
+PARQUET="$WORK/fineweb.parquet"
+TEXTS="$WORK/texts.json"
+TOKENIZER="$WORK/tokenizer/tokenizer.json"
+TOKENS="$WORK/tokens.json"
+
+# Step 1: download a FineWeb shard (reference recipe.sh:13-19)
+if [ ! -f "$PARQUET" ]; then
+    echo "== Step 1: downloading FineWeb shard"
+    curl -fL "$FINEWEB_URL" -o "$PARQUET" || {
+        echo "download failed (no network?) — place a parquet at $PARQUET"; exit 1; }
+else
+    echo "== Step 1: $PARQUET exists, skipping"
+fi
+
+# Step 2: preprocess parquet -> text JSON (reference recipe.sh:22-29)
+if [ ! -f "$TEXTS" ]; then
+    echo "== Step 2: preprocessing"
+    python -m distributed_pytorch_from_scratch_tpu.data.preprocess -i "$PARQUET" -o "$TEXTS"
+else
+    echo "== Step 2: $TEXTS exists, skipping"
+fi
+
+# Step 3: train BPE tokenizer (reference recipe.sh:32-39)
+if [ ! -f "$TOKENIZER" ]; then
+    echo "== Step 3: training tokenizer (vocab $VOCAB_SIZE)"
+    python -m distributed_pytorch_from_scratch_tpu.data.tokenizer train \
+        -d "$TEXTS" -v "$VOCAB_SIZE" -o "$TOKENIZER"
+else
+    echo "== Step 3: $TOKENIZER exists, skipping"
+fi
+
+# Step 4: pre-tokenize (reference recipe.sh:41-48)
+if [ ! -f "$TOKENS" ]; then
+    echo "== Step 4: pre-tokenizing"
+    python -m distributed_pytorch_from_scratch_tpu.data.tokenizer encode \
+        -i "$TEXTS" -o "$TOKENS" -t "$TOKENIZER"
+else
+    echo "== Step 4: $TOKENS exists, skipping"
+fi
+
+# Steps 5..: train + eval per TP size (reference recipe.sh:51-125)
+for TP in $TP_SIZES; do
+    CKPT="$WORK/checkpoints_tp${TP}"
+    if [ ! -d "$CKPT" ] || [ -z "$(ls -A "$CKPT" 2>/dev/null | grep -v logs || true)" ]; then
+        echo "== Train: TP=$TP DP=$DP_SIZE"
+        python -m distributed_pytorch_from_scratch_tpu.train \
+            --tp_size "$TP" --dp_size "$DP_SIZE" \
+            --data_path "$TOKENS" --save_dir "$CKPT" \
+            --batch_size "$BATCH_SIZE" --max_steps "$MAX_STEPS" \
+            --save_interval "$SAVE_INTERVAL" --log_interval "$LOG_INTERVAL" --bf16
+    else
+        echo "== Train TP=$TP: checkpoints exist, skipping"
+    fi
+    echo "== Eval: TP=$TP"
+    python -m distributed_pytorch_from_scratch_tpu.evaluate \
+        --tp_size "$TP" --ckpt_dir "$CKPT" \
+        --data_path "$TOKENS" --tokenizer_path "$TOKENIZER"
+done
+echo "== recipe complete"

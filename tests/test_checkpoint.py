@@ -1,0 +1,96 @@
+"""Checkpoint tests: per-shard save/load, filename convention, retention,
+resume state, and mesh-independence (save at TP=4, load for TP=2).
+
+Reference behaviours mirrored: filename metadata + regex discovery
+(`/root/reference/train.py:123,129`, `test.py:94-95`), retention pruning
+(`train.py:127-132`); fixed here: optimizer/step state is saved so training
+can resume (the reference cannot — SURVEY §5.4).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_from_scratch_tpu.config import MeshConfig, ModelConfig
+from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
+from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+    latest_step, list_checkpoints, load_checkpoint, save_checkpoint)
+from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                  vocab_size=64, maxlen=16)
+
+
+def _tree_equal(a, b):
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), a, b)
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = Transformer(CFG, tp_size=4)
+    params = model.init(jax.random.key(0))
+    opt = init_adam_state(params)
+    opt = opt._replace(step=jnp.asarray(123, jnp.int32),
+                       mu=jax.tree.map(lambda p: p + 1.0, opt.mu))
+
+    paths = save_checkpoint(str(tmp_path), 123, 2.5, params, model.specs(),
+                            tp_size=4, opt_state=opt)
+    assert len(paths) == 4
+    assert os.path.basename(paths[0]) == "tprank-0_iter-123_loss-2.5000.npz"
+
+    loaded, opt_loaded, step = load_checkpoint(str(tmp_path), 123, params,
+                                               model.specs(), with_opt=True)
+    assert step == 123
+    _tree_equal(loaded, params)
+    _tree_equal(opt_loaded.mu, opt.mu)
+    assert int(opt_loaded.step) == 123
+
+
+def test_shards_are_actual_slices(tmp_path):
+    """Each rank file must hold only its slice (not the full weight) — the
+    same per-rank layout as the reference's per-process state_dicts."""
+    model = Transformer(CFG, tp_size=4)
+    params = model.init(jax.random.key(1))
+    save_checkpoint(str(tmp_path), 1, 1.0, params, model.specs(), tp_size=4)
+    shard0 = np.load(os.path.join(tmp_path, "tprank-0_iter-1_loss-1.0000.npz"))
+    # embedding is P('tp', None): vocab 64 / 4 = 16 rows per shard
+    emb = shard0["param/embedding/weight"]
+    assert emb.shape == (16, CFG.attn_dim)
+    np.testing.assert_array_equal(emb, np.asarray(params["embedding"]["weight"])[:16])
+    # norm scale is replicated: full size in every shard
+    assert shard0["param/norm/scale"].shape == (CFG.attn_dim,)
+
+
+def test_retention_pruning(tmp_path):
+    model = Transformer(CFG, tp_size=2)
+    params = model.init(jax.random.key(2))
+    for it in (10, 20, 30, 40):
+        save_checkpoint(str(tmp_path), it, 1.0, params, model.specs(),
+                        tp_size=2, reserve_last_n=2)
+    kept = [it for it, _ in list_checkpoints(str(tmp_path), rank=0)]
+    assert kept == [30, 40]
+    assert latest_step(str(tmp_path)) == 40
+
+
+def test_mesh_independent_reload(tmp_path):
+    """Save at TP=4, reassemble, and use for a TP=2 (or TP=1) model: global
+    arrays identical — checkpoints are not tied to the mesh they were written
+    from (unlike the reference, where rank files only load at the same
+    tp_size)."""
+    m4 = Transformer(CFG, tp_size=4)
+    params = m4.init(jax.random.key(3))
+    save_checkpoint(str(tmp_path), 5, 1.0, params, m4.specs(), tp_size=4)
+
+    m2 = Transformer(CFG, tp_size=2)
+    loaded, _, _ = load_checkpoint(str(tmp_path), 5, params, m4.specs())
+    _tree_equal(loaded, params)
+    # and it actually runs on a tp=2 mesh
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    sharded = jax.device_put(loaded, m2.shardings(mesh))
+    ids = jnp.zeros((2, 8), jnp.int32)
+    pos = jnp.tile(jnp.arange(8)[None, :], (2, 1))
+    logits = m2.make_forward(mesh)(sharded, ids, pos)
+    assert np.isfinite(np.asarray(logits)).all()

@@ -1,0 +1,130 @@
+"""Data pipeline tests: preprocess -> tokenizer -> pre-tokenize -> batches.
+
+Covers the reference's offline pipeline (`preprocess_data.py`,
+`train_tokenizer.py`, `pre_tokenize.py`, `dataset.py`) including schema
+compatibility with the reference's shipped tokenizer and collate semantics
+(`dataset.py:40-55`).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_from_scratch_tpu.config import (
+    BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, UNK_TOKEN)
+from distributed_pytorch_from_scratch_tpu.data.dataset import (
+    DataLoader, TokenDataset, collate, get_dataloader)
+from distributed_pytorch_from_scratch_tpu.data.tokenizer import (
+    pre_tokenize, train_bpe)
+
+TEXTS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello world this is a test of the tokenizer",
+    "distributed training from scratch on tpu hardware",
+    "megatron style tensor parallelism with jax",
+] * 8
+
+
+@pytest.fixture(scope="module")
+def pipeline(tmp_path_factory):
+    d = tmp_path_factory.mktemp("data")
+    text_json = d / "texts.json"
+    with open(text_json, "w") as f:
+        json.dump({"train": TEXTS, "validation": TEXTS[:4]}, f)
+    tok_path = d / "tokenizer.json"
+    train_bpe(str(text_json), str(tok_path), vocab_size=300)
+    tokens_json = d / "tokens.json"
+    pre_tokenize(str(text_json), str(tokens_json), str(tok_path))
+    return {"dir": d, "text_json": text_json, "tok": tok_path,
+            "tokens": tokens_json}
+
+
+def test_token_json_schema(pipeline):
+    with open(pipeline["tokens"]) as f:
+        data = json.load(f)
+    # byte-compatible with the reference's pre_tokenize.py:43-48 output
+    assert set(data) == {"train", "validation", "special_ids", "vocab_size"}
+    assert set(data["special_ids"]) == {BOS_TOKEN, EOS_TOKEN, UNK_TOKEN}
+    assert all(isinstance(x, list) for x in data["train"])
+    assert data["special_ids"][BOS_TOKEN] == 0
+    assert data["special_ids"][EOS_TOKEN] == 1
+    assert data["special_ids"][UNK_TOKEN] == 2
+
+
+def test_reference_shipped_tokenizer_loads():
+    """The reference ships a trained tokenizer.json; our pipeline must accept
+    it directly (same library, same format)."""
+    ref_tok = "/root/reference/tokenizer/tokenizer.json"
+    if not os.path.exists(ref_tok):
+        pytest.skip("reference tokenizer not present")
+    from tokenizers import Tokenizer
+    tok = Tokenizer.from_file(ref_tok)
+    assert tok.get_vocab_size() == 1024
+    assert tok.token_to_id(BOS_TOKEN) == 0
+    ids = tok.encode("hello world").ids
+    assert tok.decode(ids).strip() == "hello world"
+
+
+def test_collate_semantics():
+    """input = [BOS]+tokens padded EOS; target = tokens+[EOS] padded IGNORE
+    (reference dataset.py:40-55)."""
+    bos, eos = 0, 1
+    batch = [[5, 6, 7], [8]]
+    out = collate(batch, bos, eos, IGNORE_INDEX, pad_to=6)
+    np.testing.assert_array_equal(out["input_ids"],
+                                  [[0, 5, 6, 7, 1, 1], [0, 8, 1, 1, 1, 1]])
+    np.testing.assert_array_equal(out["target_ids"],
+                                  [[5, 6, 7, 1, -1, -1], [8, 1, -1, -1, -1, -1]])
+    np.testing.assert_array_equal(out["position_ids"][0], np.arange(6))
+
+
+def test_collate_per_batch_max_matches_reference_shape():
+    """without pad_to, width is batch max + 1 like the reference."""
+    out = collate([[5, 6, 7], [8]], 0, 1, IGNORE_INDEX)
+    assert out["input_ids"].shape == (2, 4)
+
+
+def test_dataset_truncation(pipeline):
+    ds = TokenDataset(str(pipeline["tokens"]), "train", maxlen=4)
+    for i in range(len(ds)):
+        assert len(ds[i]) <= 3  # maxlen - 1
+
+
+def test_dataloader_fixed_shapes_and_epochs(pipeline):
+    dl = get_dataloader(str(pipeline["tokens"]), batch_size=8,
+                        split="train", maxlen=32, seed=1)
+    shapes = set()
+    b0 = None
+    for batch in dl.epoch(0):
+        shapes.add(batch["input_ids"].shape)
+        if b0 is None:
+            b0 = batch["input_ids"].copy()
+    assert len(shapes) == 1, f"recompile hazard: varying shapes {shapes}"
+    assert shapes.pop() == (8, 32)
+    # different epoch -> different order; same epoch -> same order (seeded)
+    b0_again = next(iter(dl.epoch(0)))["input_ids"]
+    np.testing.assert_array_equal(b0, b0_again)
+    b1 = next(iter(dl.epoch(1)))["input_ids"]
+    assert not np.array_equal(b0, b1)
+
+
+def test_dataloader_validation_keeps_tail(pipeline):
+    dl = get_dataloader(str(pipeline["tokens"]), batch_size=3,
+                        split="validation", maxlen=32, shuffle=False)
+    total = sum(b["input_ids"].shape[0] for b in dl.epoch(0))
+    assert total == 4  # drop_last defaults off for validation
+
+
+def test_preprocess(tmp_path):
+    pd = pytest.importorskip("pandas")
+    pq = tmp_path / "raw.parquet"
+    texts = [f"document number {i} " + "x" * (i * 10) for i in range(50)]
+    pd.DataFrame({"text": texts}).to_parquet(pq)
+    from distributed_pytorch_from_scratch_tpu.data.preprocess import preprocess
+    out = tmp_path / "texts.json"
+    data = preprocess(str(pq), str(out), max_chars=200, val_ratio=0.1, seed=0)
+    assert set(data) == {"train", "validation"}
+    assert all(len(t) <= 200 for t in data["train"] + data["validation"])
+    assert len(data["validation"]) >= 1

@@ -1,0 +1,95 @@
+"""End-to-end smoke: the full CLI pipeline on a tiny corpus.
+
+The cluster-free analogue of the reference's `recipe.sh` integration flow
+(SURVEY §3.3): texts -> tokenizer -> token JSON -> `train.main` (TP=2, DP=2,
+checkpoints, resume) -> `evaluate.main` (per-ckpt val loss + greedy decode),
+all on the virtual CPU mesh.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_pytorch_from_scratch_tpu import evaluate as eval_mod
+from distributed_pytorch_from_scratch_tpu import train as train_mod
+from distributed_pytorch_from_scratch_tpu.data.tokenizer import (
+    pre_tokenize, train_bpe)
+from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+    latest_step, list_checkpoints)
+
+TEXTS = [
+    "the king rode out at dawn with his men",
+    "a quiet morning on the river bank",
+    "she sold sea shells by the sea shore",
+    "to be or not to be that is the question",
+    "all the world is a stage and we are players",
+    "the lazy dog slept while the fox jumped",
+    # cover the bytes (capitals, punctuation) of evaluate.DECODE_PROMPTS so
+    # the tiny tokenizer can round-trip them (byte-level BPE only includes
+    # bytes seen in training)
+    "Nice to meet you, it's a Great day; Your majesty, I shall be glad",
+    "What a glory to see; Shame for the weak, The brave man ne, Poor old man",
+] * 6
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    d = tmp_path_factory.mktemp("e2e")
+    text_json = d / "texts.json"
+    with open(text_json, "w") as f:
+        json.dump({"train": TEXTS, "validation": TEXTS[:6]}, f)
+    tok = d / "tokenizer.json"
+    train_bpe(str(text_json), str(tok), vocab_size=280)
+    tokens = d / "tokens.json"
+    pre_tokenize(str(text_json), str(tokens), str(tok))
+    return {"dir": d, "tokens": tokens, "tok": tok}
+
+
+MODEL_FLAGS = ["--attn_dim", "32", "--ffn_dim", "64", "--num_heads", "8",
+               "--num_layers", "2", "--maxlen", "32"]
+
+
+def test_train_eval_resume_e2e(corpus):
+    save_dir = str(corpus["dir"] / "ckpts")
+    base = ["--tp_size", "2", "--dp_size", "2",
+            "--data_path", str(corpus["tokens"]),
+            "--save_dir", save_dir,
+            "--batch_size", "4", "--log_interval", "2",
+            "--save_interval", "4", "--warmup_steps", "2",
+            *MODEL_FLAGS]
+
+    # train 8 steps, checkpoints at 4 and 8
+    train_mod.main(base + ["--max_steps", "8"])
+    assert latest_step(save_dir) == 8
+    assert len(list_checkpoints(save_dir, rank=0)) == 2
+    assert len(list_checkpoints(save_dir, rank=1)) == 2
+
+    # resume to 12: must continue from 8, not restart
+    train_mod.main(base + ["--max_steps", "12", "--resume"])
+    assert latest_step(save_dir) == 12
+
+    # evaluate all checkpoints + greedy decode
+    result = eval_mod.evaluate(eval_mod.get_eval_args([
+        "--tp_size", "2",
+        "--ckpt_dir", save_dir,
+        "--data_path", str(corpus["tokens"]),
+        "--tokenizer_path", str(corpus["tok"]),
+        "--max_decode_len", "16",
+        "--no-bf16",
+        *MODEL_FLAGS]))
+    assert set(result["val_losses"]) == {4, 8, 12}
+    assert all(np.isfinite(v) for v in result["val_losses"].values())
+    assert len(result["decoded"]) == len(eval_mod.DECODE_PROMPTS)
+    report = os.path.join(save_dir, "val", "val.txt")
+    assert os.path.exists(report)
+    text = open(report).read()
+    assert "Validation loss" in text and "Decoded texts" in text
+
+
+def test_train_rejects_oversized_mesh(corpus):
+    with pytest.raises(SystemExit, match="devices"):
+        train_mod.train(train_mod.get_train_args([
+            "--tp_size", "64", "--data_path", str(corpus["tokens"]),
+            *MODEL_FLAGS, "--max_steps", "1"]))
