@@ -60,7 +60,7 @@ class Transformer:
 
     cfg: ModelConfig
     tp_size: int = 1
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"  # flash kernel on TPU, XLA path on CPU
     # Rematerialise each decoder layer in the backward pass instead of saving
     # its activations (the naive O(T^2) attention otherwise stores
     # (L, b, heads, t, t) softmax residuals — 11.7 GiB for the reference's
@@ -68,10 +68,20 @@ class Transformer:
     # HBM residuals for recompute FLOPs is the standard TPU playbook
     # (SURVEY §0 / scaling-book); the reference has no analogue (PyTorch
     # keeps all residuals and simply needs a bigger GPU).
-    remat: bool = True
+    #   True   — full per-layer remat (lowest memory, ~33% recompute FLOPs)
+    #   "dots" — jax.checkpoint_policies.checkpoint_dots: matmul outputs are
+    #            saved, only elementwise ops recompute (best speed that still
+    #            bounds residuals; needs flash attention or short t, since
+    #            the XLA attention path's softmax residual is O(t^2))
+    #   False  — no remat (reference behaviour; OOMs the 45M b32xt1000 run
+    #            on a 16G chip)
+    remat: "bool | str" = True
 
     def __post_init__(self):
         cfg, tp = self.cfg, self.tp_size
+        if self.remat not in (True, False, "dots"):
+            raise ValueError(
+                f"remat must be True, False or 'dots', got {self.remat!r}")
         if cfg.num_heads % tp != 0:
             raise ValueError(f"num_heads {cfg.num_heads} not divisible by tp_size {tp}")
         if cfg.attn_dim % tp != 0 or cfg.ffn_dim % tp != 0:
@@ -218,7 +228,11 @@ class Transformer:
         sin = jnp.take(sin_t, position_ids, axis=0, mode="clip")
 
         layer_fn = self._layer_body
-        if self.remat:
+        if self.remat == "dots":
+            layer_fn = jax.checkpoint(
+                layer_fn, static_argnums=(4,),
+                policy=jax.checkpoint_policies.checkpoint_dots)
+        elif self.remat:
             layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
 
         def body(carry, layer_params):

@@ -30,7 +30,12 @@ def causal_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "xla") -> jax.Array:
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "auto") -> jax.Array:
+    if impl == "auto":
+        # Pallas flash on real TPU (1.5x faster fwd+bwd at reference scale,
+        # takes the 45M b32xt1000 train step from 25.9% to 30.0% MFU on v5e);
+        # on CPU the kernel only runs interpreted (slow), so use XLA there.
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
         return causal_attention_xla(q, k, v)
     if impl == "flash":
