@@ -82,19 +82,22 @@ class ModelConfig:
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """2-D device mesh: ('dp', 'tp').
+    """3-D device mesh: ('dp', 'cp', 'tp').
 
     The reference supports exactly one axis (TP == world size, asserted at
     `/root/reference/process_manager.py:13`). We design for >=2 axes from day
-    one per BASELINE.json config 5 (TPxDP 4x2).
+    one per BASELINE.json config 5 (TPxDP 4x2), plus a context-parallel axis
+    'cp' for long sequences (ring attention / Ulysses — absent from the
+    reference, SURVEY §2.4) that defaults to size 1.
     """
 
     dp: int = 1
     tp: int = 1
+    cp: int = 1
 
     @property
     def world_size(self) -> int:
-        return self.dp * self.tp
+        return self.dp * self.cp * self.tp
 
 
 @dataclass(frozen=True)

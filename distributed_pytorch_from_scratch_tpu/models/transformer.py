@@ -43,6 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import IGNORE_INDEX, ModelConfig, resolve_dtype
 from ..ops.attention import causal_attention
 from ..ops.collectives import gather_from, reduce_from
+from ..ops.ring_attention import ring_attention, ulysses_attention
 from ..ops.rope import apply_rotary, rope_tables
 from ..parallel.embedding import VocabParallelEmbedding
 from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
@@ -61,6 +62,13 @@ class Transformer:
     cfg: ModelConfig
     tp_size: int = 1
     attn_impl: str = "auto"  # flash kernel on TPU, XLA path on CPU
+    # Context parallelism: shard the sequence dim over the mesh axis 'cp'
+    # (absent from the reference — SURVEY §5.7 documents it has no
+    # long-context story at all). cp_impl: 'ring' rotates KV chunks around
+    # the cp ring with online-softmax combination; 'ulysses' all-to-alls
+    # heads<->sequence and runs the dense kernel on the full sequence.
+    cp_size: int = 1
+    cp_impl: str = "ring"
     # Rematerialise each decoder layer in the backward pass instead of saving
     # its activations (the naive O(T^2) attention otherwise stores
     # (L, b, heads, t, t) softmax residuals — 11.7 GiB for the reference's
@@ -88,6 +96,14 @@ class Transformer:
             raise ValueError(
                 f"attn_dim {cfg.attn_dim} and ffn_dim {cfg.ffn_dim} must be "
                 f"divisible by tp_size {tp}")
+        if self.cp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"cp_impl must be 'ring' or 'ulysses', got "
+                             f"{self.cp_impl!r}")
+        if (self.cp_size > 1 and self.cp_impl == "ulysses"
+                and (cfg.num_heads // tp) % self.cp_size != 0):
+            raise ValueError(
+                f"ulysses needs local heads {cfg.num_heads // tp} divisible "
+                f"by cp_size {self.cp_size}; use cp_impl='ring'")
 
     # ---- sub-module definitions (static, cheap to rebuild) ----
 
@@ -183,7 +199,8 @@ class Transformer:
     # ---- per-shard forward (call inside shard_map) ----
 
     def _layer_body(self, x: jax.Array, layer_params: Params,
-                    cos: jax.Array, sin: jax.Array, dtype) -> jax.Array:
+                    cos: jax.Array, sin: jax.Array, pos: jax.Array,
+                    dtype) -> jax.Array:
         m = self._mods
         b, t, _ = x.shape
         h = self.cfg.head_dim
@@ -197,7 +214,13 @@ class Transformer:
         split_heads = lambda z: z.reshape(b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
         q, k, v = split_heads(q), split_heads(k), split_heads(v)
         q, k = apply_rotary(q, k, cos, sin)
-        o = causal_attention(q, k, v, impl=self.attn_impl)
+        if self.cp_size > 1:
+            if self.cp_impl == "ring":
+                o = ring_attention(q, k, v, pos, axis="cp")
+            else:
+                o = ulysses_attention(q, k, v, axis="cp", impl=self.attn_impl)
+        else:
+            o = causal_attention(q, k, v, impl=self.attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.num_local_heads * h)
         x = x + m["wo"].apply(layer_params["wo"], o, dtype)
 
@@ -230,13 +253,14 @@ class Transformer:
         layer_fn = self._layer_body
         if self.remat == "dots":
             layer_fn = jax.checkpoint(
-                layer_fn, static_argnums=(4,),
+                layer_fn, static_argnums=(5,),
                 policy=jax.checkpoint_policies.checkpoint_dots)
         elif self.remat:
-            layer_fn = jax.checkpoint(layer_fn, static_argnums=(4,))
+            layer_fn = jax.checkpoint(layer_fn, static_argnums=(5,))
 
         def body(carry, layer_params):
-            return layer_fn(carry, layer_params, cos, sin, dtype), None
+            return layer_fn(carry, layer_params, cos, sin, position_ids,
+                            dtype), None
 
         x, _ = lax.scan(body, x, params["layers"])
         x = self.final_norm.apply(params["norm"], x)
@@ -256,8 +280,8 @@ class Transformer:
     def loss_shard(self, params: Params, input_ids: jax.Array,
                    target_ids: jax.Array, position_ids: jax.Array,
                    mode: str = "vocab_parallel",
-                   dp_axis: str = "dp") -> jax.Array:
-        """Mean cross-entropy over non-ignored tokens, global over ('dp','tp').
+                   batch_axes: Tuple[str, ...] = ("dp", "cp")) -> jax.Array:
+        """Mean cross-entropy over non-ignored tokens, global over the mesh.
 
         f32 loss with ignore-index masking, matching the reference's
         `F.cross_entropy(logits.float(), ..., ignore_index=-1, 'mean')`
@@ -301,8 +325,8 @@ class Transformer:
 
         loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
         count = jnp.sum(valid.astype(jnp.float32))
-        loss_sum = lax.psum(loss_sum, dp_axis)
-        count = lax.psum(count, dp_axis)
+        loss_sum = lax.psum(loss_sum, batch_axes)
+        count = lax.psum(count, batch_axes)
         return loss_sum / jnp.maximum(count, 1.0)
 
     # ---- global (jitted) entry points ----
@@ -312,8 +336,8 @@ class Transformer:
         logits (b, t, vocab_padded), vocab dim sharded over 'tp'."""
         fwd = jax.shard_map(
             self.forward_shard, mesh=mesh,
-            in_specs=(self.specs(), P("dp", None), P("dp", None)),
-            out_specs=P("dp", None, "tp"),
+            in_specs=(self.specs(), P("dp", "cp"), P("dp", "cp")),
+            out_specs=P("dp", "cp", "tp"),
         )
         return jax.jit(fwd)
 
@@ -321,7 +345,7 @@ class Transformer:
         loss = functools.partial(self.loss_shard, mode=mode)
         fn = jax.shard_map(
             loss, mesh=mesh,
-            in_specs=(self.specs(), P("dp", None), P("dp", None), P("dp", None)),
+            in_specs=(self.specs(), P("dp", "cp"), P("dp", "cp"), P("dp", "cp")),
             out_specs=P(),
         )
         return jax.jit(fn)

@@ -1,0 +1,140 @@
+"""Context-parallel causal attention over a mesh axis: ring + Ulysses.
+
+The reference has NO long-context story: its attention materialises the full
+(b, heads, t, t) score tensor on one device and sequence length is capped at
+maxlen=1000 (`/root/reference/models/model.py:73-77`, SURVEY §5.7). Here the
+sequence dimension shards over the mesh axis 'cp' and two TPU-native
+strategies make attention work across the shards:
+
+* **Ring attention** (`ring_attention`): each shard keeps its Q chunk and
+  rotates K/V chunks around the 'cp' ring with `lax.ppermute` (one ICI hop
+  per step), combining per-chunk partial results with the online-softmax
+  (flash-attention) recurrence in f32. Compute for each (Q-chunk, KV-chunk)
+  block is a dense MXU matmul; causal masking uses the *global* positions
+  carried around the ring with K/V, so arbitrary `position_ids` work.
+  Memory is O(t_local^2) per block instead of O(t^2).
+
+* **Ulysses** (`ulysses_attention`): two `lax.all_to_all`s swap the
+  head-sharding for sequence-sharding — each shard then holds the FULL
+  sequence for a subset of its local heads and runs any single-device kernel
+  (including the Pallas flash kernel) unchanged, then swaps back. Cheaper
+  compute-wise (no duplicated softmax bookkeeping) but needs
+  num_local_heads % cp == 0 and moves activations twice.
+
+Both are differentiable with plain JAX autodiff: the transpose of `ppermute`
+is the reverse permutation and the transpose of `all_to_all` is the inverse
+all-to-all, so the backward pass's communication schedule is derived
+automatically (the hand-written ring backward of the ring-attention paper
+falls out of `lax.scan`'s transpose).
+
+Call from inside `shard_map` code partitioned over `axis`.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .attention import causal_attention
+from .collectives import all_to_all, ring_permute
+
+_BIG_NEG = -1e30  # mask fill for f32 online softmax; exp() underflows to 0
+
+
+def _block_attn(q, k, v, q_pos, kv_pos, scale):
+    """One (Q-chunk, KV-chunk) block: returns (numerator, max, sumexp).
+
+    q: (b, h, tq, d); k, v: (b, h, tk, d); q_pos: (b, tq); kv_pos: (b, tk).
+    All softmax bookkeeping in f32.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    causal = q_pos[:, None, :, None] >= kv_pos[:, None, None, :]
+    s = jnp.where(causal, s, _BIG_NEG)
+    m = jnp.max(s, axis=-1)                          # (b, h, tq)
+    p = jnp.exp(s - m[..., None])
+    # rows with no visible kv in this block: m = _BIG_NEG, p = 1 everywhere —
+    # zero them so they contribute nothing.
+    alive = m > _BIG_NEG / 2
+    p = jnp.where(alive[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                          # (b, h, tq)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   q_pos: jax.Array, axis: str = "cp") -> jax.Array:
+    """Causal attention with the sequence dim sharded over `axis`.
+
+    q, k, v: (b, heads_local, t_local, head_dim) — this shard's chunk.
+    q_pos:   (b, t_local) global positions of this shard's tokens (the same
+             `position_ids` the model already carries; the K/V copy rides the
+             ring so causal masks are exact for any position layout).
+    Returns (b, heads_local, t_local, head_dim), same dtype as q.
+    """
+    n = lax.axis_size(axis)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    qf = q.astype(jnp.float32)
+
+    # derive the accumulators from qf so they inherit its varying-axes tags
+    # (fresh jnp.zeros would be mesh-invariant and trip shard_map's vma check
+    # on the scan carry)
+    o0 = jnp.zeros_like(qf)
+    m0 = qf[..., 0] * 0.0 + _BIG_NEG
+    l0 = qf[..., 0] * 0.0
+
+    def accumulate(o, m, l, k_cur, v_cur, pos_cur):
+        bo, bm, bl = _block_attn(qf, k_cur, v_cur, q_pos, pos_cur, scale)
+        m_new = jnp.maximum(m, bm)
+        # correction factors; exp(_BIG_NEG - m_new) underflows to exactly 0
+        c_old = jnp.exp(m - m_new)
+        c_blk = jnp.exp(bm - m_new)
+        o = o * c_old[..., None] + bo * c_blk[..., None]
+        l = l * c_old + bl * c_blk
+        return o, m_new, l
+
+    def step(carry, _):
+        o, m, l, k_cur, v_cur, pos_cur = carry
+        o, m, l = accumulate(o, m, l, k_cur, v_cur, pos_cur)
+        # rotate KV (+ its positions) one hop around the ring
+        k_nxt = ring_permute(k_cur, axis)
+        v_nxt = ring_permute(v_cur, axis)
+        pos_nxt = ring_permute(pos_cur, axis)
+        return (o, m, l, k_nxt, v_nxt, pos_nxt), None
+
+    # n-1 rotating steps, then a final accumulate with no ppermute: the last
+    # hop's rotated KV would be discarded, and XLA cannot DCE a collective
+    # inside the compiled scan body. With cp=1 this is fully collective-free.
+    (o, m, l, k_l, v_l, pos_l), _ = lax.scan(
+        step, (o0, m0, l0, k, v, q_pos), None, length=n - 1)
+    o, m, l = accumulate(o, m, l, k_l, v_l, pos_l)
+    # every query attends at least to itself => l > 0 for real tokens
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis: str = "cp", impl: str = "auto") -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style).
+
+    q, k, v: (b, heads_local, t_local, head_dim), sequence sharded over
+    `axis` in contiguous rank-order chunks (the collate layout). Swaps to
+    (b, heads_local/cp, t_full, head_dim), runs the normal causal kernel
+    (Pallas flash on TPU), swaps back. Requires heads_local % cp == 0 and
+    contiguous equal chunks — for anything rangier use `ring_attention`.
+    """
+    n = lax.axis_size(axis)
+    h = q.shape[1]
+    if h % n != 0:
+        raise ValueError(
+            f"ulysses needs heads_local ({h}) divisible by cp axis size ({n})")
+    # split heads (axis 1) over cp, gather sequence (axis 2)
+    swap = functools.partial(all_to_all, axis=axis, split_axis=1, concat_axis=2)
+    unswap = functools.partial(all_to_all, axis=axis, split_axis=2, concat_axis=1)
+    o = causal_attention(swap(q), swap(k), swap(v), impl=impl)
+    return unswap(o)

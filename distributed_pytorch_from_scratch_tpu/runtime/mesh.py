@@ -27,12 +27,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import MeshConfig
 
 DP_AXIS = "dp"
+CP_AXIS = "cp"
 TP_AXIS = "tp"
-AXIS_NAMES = (DP_AXIS, TP_AXIS)
+AXIS_NAMES = (DP_AXIS, CP_AXIS, TP_AXIS)
 
 
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build the ('dp', 'tp') mesh.
+    """Build the ('dp', 'cp', 'tp') mesh.
 
     Replaces `init_pgm` (`/root/reference/process_manager.py:23-25`): where the
     reference carved a 1-D `torch.arange(world).view(tp_size)` grid into one
@@ -41,18 +42,20 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -
 
     The 'tp' axis is innermost (fastest-varying over devices) so TP
     collectives — the per-layer latency-critical ops, see SURVEY §3.1 —
-    ride neighbouring ICI links.
+    ride neighbouring ICI links. 'cp' (ring-attention KV hops, once per ring
+    step) sits between, and 'dp' (one gradient all-reduce per step) is
+    outermost.
     """
     if devices is None:
         devices = jax.devices()
-    n = cfg.dp * cfg.tp
+    n = cfg.world_size
     if n > len(devices):
         raise ValueError(
-            f"Mesh {cfg.dp}x{cfg.tp} needs {n} devices but only "
+            f"Mesh {cfg.dp}x{cfg.cp}x{cfg.tp} needs {n} devices but only "
             f"{len(devices)} are visible"
         )
-    grid = np.asarray(devices[:n]).reshape(cfg.dp, cfg.tp)
-    return Mesh(grid, AXIS_NAMES, axis_types=(jax.sharding.AxisType.Auto,) * 2)
+    grid = np.asarray(devices[:n]).reshape(cfg.dp, cfg.cp, cfg.tp)
+    return Mesh(grid, AXIS_NAMES, axis_types=(jax.sharding.AxisType.Auto,) * 3)
 
 
 def single_device_mesh() -> Mesh:
@@ -66,7 +69,8 @@ def tp_mesh(tp: int) -> Mesh:
 
 
 def mesh_shape(mesh: Mesh) -> MeshConfig:
-    return MeshConfig(dp=mesh.shape[DP_AXIS], tp=mesh.shape[TP_AXIS])
+    return MeshConfig(dp=mesh.shape[DP_AXIS], tp=mesh.shape[TP_AXIS],
+                      cp=mesh.shape.get(CP_AXIS, 1))
 
 
 def named(mesh: Mesh, *spec) -> NamedSharding:

@@ -45,6 +45,9 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g = p.add_argument_group("distributed")
     g.add_argument("--tp_size", type=int, default=1)
     g.add_argument("--dp_size", type=int, default=1)
+    g.add_argument("--cp_size", type=int, default=1,
+                   help="context-parallel (sequence) axis size")
+    g.add_argument("--cp_impl", choices=["ring", "ulysses"], default="ring")
 
     g = p.add_argument_group("training")
     g.add_argument("--lr", type=float, default=3e-4)
@@ -80,13 +83,21 @@ def get_train_args(argv=None) -> argparse.Namespace:
 
 
 def train(args: argparse.Namespace) -> dict:
-    mesh_cfg = MeshConfig(dp=args.dp_size, tp=args.tp_size)
+    mesh_cfg = MeshConfig(dp=args.dp_size, tp=args.tp_size, cp=args.cp_size)
     if mesh_cfg.world_size > jax.device_count():
         raise SystemExit(
-            f"mesh {args.dp_size}x{args.tp_size} needs {mesh_cfg.world_size} "
+            f"mesh {args.dp_size}x{args.cp_size}x{args.tp_size} needs "
+            f"{mesh_cfg.world_size} "
             f"devices; only {jax.device_count()} visible "
             f"({jax.devices()[0].platform}). For CPU testing set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count=N")
+    if args.maxlen % args.cp_size != 0:
+        raise SystemExit(f"--maxlen {args.maxlen} must be divisible by "
+                         f"--cp_size {args.cp_size} (sequence is sharded "
+                         f"over the 'cp' mesh axis)")
+    if args.batch_size % args.dp_size != 0:
+        raise SystemExit(f"--batch_size {args.batch_size} must be divisible "
+                         f"by --dp_size {args.dp_size}")
     mesh = make_mesh(mesh_cfg)
 
     dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -98,9 +109,10 @@ def train(args: argparse.Namespace) -> dict:
                       num_heads=args.num_heads, num_layers=args.num_layers,
                       vocab_size=vocab_size, maxlen=args.maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
-    model = Transformer(cfg, tp_size=args.tp_size)
+    model = Transformer(cfg, tp_size=args.tp_size,
+                    cp_size=args.cp_size, cp_impl=args.cp_impl)
     print(f"model: {cfg.num_params()/1e6:.2f}M params, vocab={vocab_size}, "
-          f"mesh=dp{args.dp_size} x tp{args.tp_size}, "
+          f"mesh=dp{args.dp_size} x cp{args.cp_size} x tp{args.tp_size}, "
           f"compute={cfg.compute_dtype}")
 
     ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
