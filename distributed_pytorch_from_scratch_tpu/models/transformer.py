@@ -69,6 +69,16 @@ class Transformer:
     # heads<->sequence and runs the dense kernel on the full sequence.
     cp_size: int = 1
     cp_impl: str = "ring"
+    # Megatron-style sequence parallelism over 'tp' (absent from the
+    # reference: its norms are replicated and inter-block activations are
+    # full-size on every rank — SURVEY §2.4 "SP ❌"). When on, activations
+    # between sublayers are sequence-sharded over tp: the per-sublayer
+    # all-reduce splits into a reduce-scatter (row-linear output) and an
+    # all-gather (next column-linear input) — same bytes on the wire, but
+    # norms/residuals compute on t/tp tokens and inter-block activation
+    # memory drops by 1/tp. Composes with cp (t is sharded over cp first,
+    # then tp).
+    sequence_parallel: bool = False
     # Rematerialise each decoder layer in the backward pass instead of saving
     # its activations (the naive O(T^2) attention otherwise stores
     # (L, b, heads, t, t) softmax residuals — 11.7 GiB for the reference's
@@ -202,14 +212,27 @@ class Transformer:
                     cos: jax.Array, sin: jax.Array, pos: jax.Array,
                     dtype) -> jax.Array:
         m = self._mods
-        b, t, _ = x.shape
         h = self.cfg.head_dim
+        # In sequence-parallel mode x is (b, t/tp, d) between sublayers; the
+        # column-linears all-gather it back to the full local sequence t and
+        # the row-linears reduce-scatter their outputs.
+        sp = self.sequence_parallel
+        # Gather the normed activation ONCE per sublayer and share it between
+        # the projections (wq/wk/wv, gate/up): the fan-out cotangents sum at
+        # the single gather, whose transpose is one psum_scatter per sublayer
+        # (canonical Megatron SP traffic), not one per projection.
+        maybe_gather = ((lambda z: gather_from(z, "tp", tiled_axis=-2))
+                        if sp else (lambda z: z))
+        in_layout = "gathered" if sp else "replicated"
+        out_layout = "seq_sharded" if sp else "replicated"
+        b = x.shape[0]
+        t = cos.shape[1]  # full (cp-local) sequence length, not x.shape[1]
 
         # Attention sublayer: x + attn(norm1(x))   (model.py:119)
-        y = m["norm1"].apply(layer_params["norm1"], x)
-        q = m["wq"].apply(layer_params["wq"], y, dtype)
-        k = m["wk"].apply(layer_params["wk"], y, dtype)
-        v = m["wv"].apply(layer_params["wv"], y, dtype)
+        y = maybe_gather(m["norm1"].apply(layer_params["norm1"], x))
+        q = m["wq"].apply(layer_params["wq"], y, dtype, input_layout=in_layout)
+        k = m["wk"].apply(layer_params["wk"], y, dtype, input_layout=in_layout)
+        v = m["wv"].apply(layer_params["wv"], y, dtype, input_layout=in_layout)
         # (b, t, local_heads*h) -> (b, local_heads, t, h)
         split_heads = lambda z: z.reshape(b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
         q, k, v = split_heads(q), split_heads(k), split_heads(v)
@@ -222,13 +245,18 @@ class Transformer:
         else:
             o = causal_attention(q, k, v, impl=self.attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.num_local_heads * h)
-        x = x + m["wo"].apply(layer_params["wo"], o, dtype)
+        x = x + m["wo"].apply(layer_params["wo"], o, dtype,
+                              output_layout=out_layout)
 
         # FFN sublayer: x + down(silu(gate(x)) * up(x))   (model.py:94-95,120)
-        y = m["norm2"].apply(layer_params["norm2"], x)
-        g = m["gate_proj"].apply(layer_params["gate_proj"], y, dtype)
-        u = m["up_proj"].apply(layer_params["up_proj"], y, dtype)
-        x = x + m["down_proj"].apply(layer_params["down_proj"], jax.nn.silu(g) * u, dtype)
+        y = maybe_gather(m["norm2"].apply(layer_params["norm2"], x))
+        g = m["gate_proj"].apply(layer_params["gate_proj"], y, dtype,
+                                 input_layout=in_layout)
+        u = m["up_proj"].apply(layer_params["up_proj"], y, dtype,
+                               input_layout=in_layout)
+        x = x + m["down_proj"].apply(layer_params["down_proj"],
+                                     jax.nn.silu(g) * u, dtype,
+                                     output_layout=out_layout)
         return x
 
     def forward_shard(self, params: Params, input_ids: jax.Array,
@@ -239,7 +267,13 @@ class Transformer:
         (out_spec P('dp', None, 'tp')) or explicitly `gather_from` the result.
         """
         dtype = resolve_dtype(self.cfg.compute_dtype)
-        x = self.embedding.apply(params["embedding"], input_ids)
+        sp = self.sequence_parallel
+        if sp and input_ids.shape[1] % self.tp_size != 0:
+            raise ValueError(
+                f"sequence_parallel needs the (cp-local) sequence length "
+                f"{input_ids.shape[1]} divisible by tp_size {self.tp_size}")
+        x = self.embedding.apply(params["embedding"], input_ids,
+                                 output_layout="seq_sharded" if sp else "replicated")
         x = x.astype(dtype)  # explicit cast, mirrors model.py:153-154
 
         cos_t, sin_t = rope_tables(self.cfg.maxlen, self.cfg.head_dim,
@@ -264,7 +298,9 @@ class Transformer:
 
         x, _ = lax.scan(body, x, params["layers"])
         x = self.final_norm.apply(params["norm"], x)
-        logits = self.lm_head.apply(params["lm_head"], x, dtype)
+        logits = self.lm_head.apply(
+            params["lm_head"], x, dtype,
+            input_layout="seq_sharded" if sp else "replicated")
 
         # Mask padded vocab entries so they carry no probability mass.
         if self.vocab_padded != self.cfg.vocab_size:

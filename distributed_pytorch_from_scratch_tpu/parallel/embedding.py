@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from ..ops.collectives import reduce_from
+from ..ops.collectives import reduce_from, reduce_scatter
 
 Params = Dict[str, Any]
 
@@ -54,8 +54,10 @@ class VocabParallelEmbedding:
     def specs(self) -> Params:
         return {"weight": P(self.axis, None)}
 
-    def apply(self, params: Params, ids: jax.Array) -> jax.Array:
-        """ids: (b, t) int32 -> (b, t, hdim) float32 (full, replicated)."""
+    def apply(self, params: Params, ids: jax.Array,
+              output_layout: str = "replicated") -> jax.Array:
+        """ids: (b, t) int32 -> (b, t, hdim) float32 ('replicated' layout) or
+        (b, t/n, hdim) ('seq_sharded' — Megatron sequence parallelism)."""
         w = params["weight"]                      # local (vocab_padded/n, hdim)
         rows = w.shape[0]
         start = lax.axis_index(self.axis) * rows
@@ -63,4 +65,6 @@ class VocabParallelEmbedding:
         local_ids = jnp.where(in_range, ids - start, 0)
         out = jnp.take(w, local_ids, axis=0, mode="clip")
         out = jnp.where(in_range[..., None], out, 0.0)
+        if output_layout == "seq_sharded":
+            return reduce_scatter(out, self.axis, scatter_axis=-2)
         return reduce_from(out, self.axis)        # sum partials across shards

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.collectives import copy_to, gather_from, reduce_from, split_to
+from ..ops.collectives import (copy_to, gather_from, reduce_from,
+                               reduce_scatter, split_to)
 
 Params = Dict[str, Any]
 
@@ -75,8 +76,23 @@ class ColumnParallelLinear:
         return s
 
     def apply(self, params: Params, x: jax.Array,
-              compute_dtype: jnp.dtype = jnp.float32) -> jax.Array:
-        x = copy_to(x, self.axis)                       # bwd: all-reduce input grads
+              compute_dtype: jnp.dtype = jnp.float32,
+              input_layout: str = "replicated") -> jax.Array:
+        if input_layout == "replicated":
+            x = copy_to(x, self.axis)                   # bwd: all-reduce input grads
+        elif input_layout == "seq_sharded":
+            # Megatron sequence parallelism: x arrives (b, t/n, d); all-gather
+            # the sequence dim. The transpose (psum_scatter over seq) is the
+            # conjugate reduce-scatter, replacing copy_to's all-reduce — same
+            # bytes on the wire, but activations upstream are 1/n-sized.
+            x = gather_from(x, self.axis, tiled_axis=-2)
+        elif input_layout == "gathered":
+            # caller already all-gathered x (e.g. once per sublayer, shared by
+            # wq/wk/wv): use as-is; fan-out cotangents sum at the caller's
+            # single gather, whose transpose is one psum_scatter.
+            pass
+        else:
+            raise ValueError(f"unknown input_layout {input_layout!r}")
         w = params["weight"].astype(compute_dtype)      # local (idim, odim/n)
         y = x.astype(compute_dtype) @ w
         if self.add_bias:
@@ -115,12 +131,22 @@ class RowParallelLinear:
         return s
 
     def apply(self, params: Params, x: jax.Array,
-              compute_dtype: jnp.dtype = jnp.float32) -> jax.Array:
+              compute_dtype: jnp.dtype = jnp.float32,
+              output_layout: str = "replicated") -> jax.Array:
         if self.split_input:
             x = split_to(x, self.axis)                  # (.., idim) -> (.., idim/n)
         w = params["weight"].astype(compute_dtype)      # local (idim/n, odim)
         y = x.astype(compute_dtype) @ w
-        y = reduce_from(y, self.axis)                   # sum partial products
+        if output_layout == "replicated":
+            y = reduce_from(y, self.axis)               # sum partial products
+        elif output_layout == "seq_sharded":
+            # Megatron sequence parallelism: reduce-scatter the partial sums
+            # over the sequence dim — each shard keeps summed (b, t/n, odim).
+            # Bias (full over odim) still applies per token, after the reduce
+            # like the reference (`layers.py:53-54`).
+            y = reduce_scatter(y, self.axis, scatter_axis=-2)
+        else:
+            raise ValueError(f"unknown output_layout {output_layout!r}")
         if self.add_bias:
             y = y + params["bias"].astype(compute_dtype)
         return y

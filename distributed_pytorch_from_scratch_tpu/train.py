@@ -48,6 +48,10 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--cp_size", type=int, default=1,
                    help="context-parallel (sequence) axis size")
     g.add_argument("--cp_impl", choices=["ring", "ulysses"], default="ring")
+    g.add_argument("--sequence_parallel", action="store_true",
+                   help="Megatron-style SP: shard inter-block activations "
+                        "over the tp axis (reduce-scatter/all-gather instead "
+                        "of all-reduce)")
 
     g = p.add_argument_group("training")
     g.add_argument("--lr", type=float, default=3e-4)
@@ -110,7 +114,8 @@ def train(args: argparse.Namespace) -> dict:
                       vocab_size=vocab_size, maxlen=args.maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
     model = Transformer(cfg, tp_size=args.tp_size,
-                    cp_size=args.cp_size, cp_impl=args.cp_impl)
+                    cp_size=args.cp_size, cp_impl=args.cp_impl,
+                    sequence_parallel=args.sequence_parallel)
     print(f"model: {cfg.num_params()/1e6:.2f}M params, vocab={vocab_size}, "
           f"mesh=dp{args.dp_size} x cp{args.cp_size} x tp{args.tp_size}, "
           f"compute={cfg.compute_dtype}")
