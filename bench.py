@@ -25,27 +25,11 @@ from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
 from distributed_pytorch_from_scratch_tpu.config import OptimizerConfig
 from distributed_pytorch_from_scratch_tpu.ops.collectives import reduce_from
 from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
+from distributed_pytorch_from_scratch_tpu.training.metrics import (
+    chip_peak_flops, model_flops_per_step)
 from distributed_pytorch_from_scratch_tpu.training.train_step import (
     build_train_step)
 
-# Peak bf16 FLOP/s per chip by device_kind, most-specific prefix first
-# (v5p must not fall into the 'TPU v5' bucket). Used only for MFU.
-PEAK_FLOPS = [
-    ("TPU v6 lite", 918e12),   # v6e / Trillium
-    ("TPU v6", 918e12),
-    ("TPU v5p", 459e12),
-    ("TPU v5 lite", 197e12),   # v5e
-    ("TPU v5", 197e12),
-    ("TPU v4", 275e12),
-]
-
-
-def chip_peak_flops() -> float:
-    kind = jax.devices()[0].device_kind
-    for prefix, v in PEAK_FLOPS:
-        if kind.startswith(prefix):
-            return v
-    return 197e12  # unknown: assume v5e
 
 
 def allreduce_p50_us(mesh, tp: int, nbytes: int = 4 * 1024 * 1024,
@@ -106,10 +90,7 @@ def main():
 
     tokens_per_sec_per_chip = B * T / step_s / n_dev
 
-    # Model-FLOPs MFU (no remat recompute counted): 6N per token + attention
-    N = cfg.num_params()
-    L, h, hd = cfg.num_layers, cfg.num_heads, cfg.head_dim
-    flops_per_step = 6 * N * B * T + 12 * L * B * h * T * T * hd
+    flops_per_step = model_flops_per_step(cfg, B, T)
     mfu = flops_per_step / step_s / (chip_peak_flops() * n_dev)
 
     p50 = allreduce_p50_us(mesh, tp) if tp > 1 else None

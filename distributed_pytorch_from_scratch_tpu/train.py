@@ -34,9 +34,12 @@ from .models.transformer import Transformer
 from .runtime.mesh import make_mesh
 from .training.checkpoint import (latest_step, load_checkpoint,
                                   save_checkpoint)
-from .training.metrics import MetricsWriter, device_memory_gib
+from .training.metrics import (MetricsWriter, ProfilerTrace,
+                               chip_peak_flops, device_memory_gib,
+                               model_flops_per_step)
 from .training.optim import init_adam_state, onecycle_lr
 from .training.train_step import build_train_step
+from .training.zero import zero1_moment_shardings
 
 
 def get_train_args(argv=None) -> argparse.Namespace:
@@ -52,6 +55,9 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="Megatron-style SP: shard inter-block activations "
                         "over the tp axis (reduce-scatter/all-gather instead "
                         "of all-reduce)")
+    g.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard Adam moments over the dp axis "
+                        "(2/dp optimizer memory per device)")
 
     g = p.add_argument_group("training")
     g.add_argument("--lr", type=float, default=3e-4)
@@ -83,10 +89,19 @@ def get_train_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
+    g.add_argument("--profile_steps", type=int, default=0,
+                   help="trace N steps with jax.profiler (written to "
+                        "SAVE_DIR/logs/profile; view in TensorBoard/xprof)")
+    g.add_argument("--debug_nans", action="store_true",
+                   help="jax.config.debug_nans: fail fast on the first "
+                        "non-finite value (the functional analogue of a "
+                        "sanitizer — SURVEY §5.2)")
     return p.parse_args(argv)
 
 
 def train(args: argparse.Namespace) -> dict:
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
     mesh_cfg = MeshConfig(dp=args.dp_size, tp=args.tp_size, cp=args.cp_size)
     if mesh_cfg.world_size > jax.device_count():
         raise SystemExit(
@@ -136,13 +151,23 @@ def train(args: argparse.Namespace) -> dict:
 
     shardings = model.shardings(mesh)
     params = jax.device_put(params, shardings)
+    moment_sh = (zero1_moment_shardings(model, mesh) if args.zero1
+                 else shardings)
     opt_state = jax.device_put(
         opt_state, opt_state.__class__(
             step=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
-            mu=shardings, nu=shardings))
+            mu=moment_sh, nu=moment_sh))
 
-    step_fn = build_train_step(model, mesh, ocfg, args.loss_mode)
+    step_fn = build_train_step(model, mesh, ocfg, args.loss_mode,
+                               zero1=args.zero1,
+                               moment_shardings=moment_sh if args.zero1 else None)
     writer = MetricsWriter(os.path.join(args.save_dir, "logs"))
+    # profile a window shortly after start so compile+layout churn is over
+    profiler = ProfilerTrace(os.path.join(args.save_dir, "logs"),
+                             start_step=start_step + 3,
+                             num_steps=args.profile_steps)
+    flops_step = model_flops_per_step(cfg, args.batch_size, args.maxlen)
+    peak_flops = chip_peak_flops() * mesh_cfg.world_size
 
     steps_per_epoch = len(dataloader)
     if steps_per_epoch == 0:
@@ -158,33 +183,40 @@ def train(args: argparse.Namespace) -> dict:
     # accumulate the loss on-device; a float() sync every step would
     # serialize host dispatch with device execution
     accum_loss, n = jnp.zeros((), jnp.float32), start_step
-    t_start, tokens_since = time.time(), 0
+    t_start, tokens_since, steps_since = time.time(), 0, 0
     done = False
     for epoch in range(start_epoch, max_epoch):
         for i, batch in enumerate(dataloader.epoch(epoch)):
             if epoch == start_epoch and i < skip_batches:
                 continue
+            if args.profile_steps:
+                profiler.maybe_start(n)
             params, opt_state, loss = step_fn(
                 params, opt_state,
                 jnp.asarray(batch["input_ids"]),
                 jnp.asarray(batch["target_ids"]),
                 jnp.asarray(batch["position_ids"]))
             n += 1
+            if args.profile_steps:
+                profiler.maybe_stop(n, sync=loss)
             accum_loss = accum_loss + loss
             tokens_since += batch["input_ids"].size
+            steps_since += 1
             if n % args.log_interval == 0:
                 lr, _ = onecycle_lr(ocfg, jnp.asarray(n - 1))
                 avg = float(accum_loss) / (n - start_step)
                 dt = time.time() - t_start
                 tps = tokens_since / max(dt, 1e-9)
+                mfu = (flops_step * steps_since) / max(dt, 1e-9) / peak_flops
                 print(f"step {n}/{args.max_steps} -> avg loss {avg:.4f}, "
                       f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s, "
-                      f"mem {device_memory_gib():.2f} GiB")
+                      f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f} GiB")
                 writer.scalar("train/ce_loss", avg, n)
                 writer.scalar("train/lr", float(lr), n)
                 writer.scalar("train/tokens_per_sec", tps, n)
+                writer.scalar("train/mfu", mfu, n)
                 writer.scalar("device_memory_gib", device_memory_gib(), n)
-                t_start, tokens_since = time.time(), 0
+                t_start, tokens_since, steps_since = time.time(), 0, 0
             if n % args.save_interval == 0:
                 avg = float(accum_loss) / (n - start_step)
                 paths = save_checkpoint(
@@ -201,6 +233,7 @@ def train(args: argparse.Namespace) -> dict:
             break
 
     final_avg = float(accum_loss) / max(n - start_step, 1)
+    profiler.close()
     writer.close()
     print(f"training finished at step {n}, avg loss {final_avg:.4f}")
     return {"steps": n, "avg_loss": final_avg}

@@ -49,6 +49,69 @@ class MetricsWriter:
             self._tb.close()
 
 
+# Peak bf16 FLOP/s per chip by device_kind, most-specific prefix first
+# (v5p must not fall into the 'TPU v5' bucket). Used for MFU reporting.
+PEAK_FLOPS = [
+    ("TPU v6 lite", 918e12),   # v6e / Trillium
+    ("TPU v6", 918e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5 lite", 197e12),   # v5e
+    ("TPU v5", 197e12),
+    ("TPU v4", 275e12),
+]
+
+
+def chip_peak_flops(device: Optional[jax.Device] = None) -> float:
+    kind = (device or jax.devices()[0]).device_kind
+    for prefix, v in PEAK_FLOPS:
+        if kind.startswith(prefix):
+            return v
+    return 197e12  # unknown: assume v5e
+
+
+def model_flops_per_step(cfg, batch: int, seqlen: int) -> float:
+    """Model FLOPs for one fwd+bwd train step (no remat recompute counted):
+    6N per token + the 12*L*h*T^2*hd attention term."""
+    n = cfg.num_params()
+    return (6 * n * batch * seqlen
+            + 12 * cfg.num_layers * batch * cfg.num_heads
+            * seqlen * seqlen * cfg.head_dim)
+
+
+class ProfilerTrace:
+    """Start/stop `jax.profiler` tracing over a step window — the TPU
+    analogue of the reference's (absent) torch profiler; SURVEY §5.1. View
+    the trace with TensorBoard's profile plugin or xprof."""
+
+    def __init__(self, log_dir: str, start_step: int, num_steps: int):
+        self.log_dir = os.path.join(log_dir, "profile")
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+
+    def maybe_start(self, step: int) -> None:
+        if not self._active and self.start_step <= step < self.stop_step:
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def maybe_stop(self, step: int, sync=None) -> None:
+        """`sync`: a device value from the last profiled step (e.g. the loss);
+        dispatch is async, so without blocking on it stop_trace would fire
+        while the profiled steps are still executing and truncate the trace."""
+        if self._active and step >= self.stop_step:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            jax.profiler.stop_trace()
+            self._active = False
+            print(f"profiler trace written to {self.log_dir}")
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+
+
 def device_memory_gib(device: Optional[jax.Device] = None) -> float:
     """Bytes in use on the device, in GiB (analogue of
     `torch.cuda.memory_reserved`, reference `train.py:119`)."""

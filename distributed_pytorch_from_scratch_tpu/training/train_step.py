@@ -15,17 +15,29 @@ from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import OptimizerConfig, TrainConfig
 from ..models.transformer import Transformer
 from .optim import AdamState, adam_update
+from .zero import zero1_moment_shardings
 
 
 def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
-                     loss_mode: str = "vocab_parallel"):
+                     loss_mode: str = "vocab_parallel",
+                     zero1: bool = False, moment_shardings=None):
     """Returns jitted
     (params, opt_state, input_ids, target_ids, position_ids)
-      -> (params, opt_state, loss)."""
+      -> (params, opt_state, loss).
+
+    With `zero1=True` the Adam moments are pinned to dp-sharded layouts
+    (see training/zero.py): XLA computes each moment/param update on the dp
+    shard that owns it and all-gathers the fresh params — ZeRO-1, derived by
+    the partitioner. `moment_shardings` lets the caller pass the tree it
+    already built (from `zero1_moment_shardings`) for `device_put`-ing the
+    initial state, so there is exactly one source of the moment layout;
+    derived here when omitted.
+    """
     loss_fn = model.make_loss(mesh, mode=loss_mode)
     grad_fn = jax.value_and_grad(loss_fn)
 
@@ -34,7 +46,16 @@ def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
         params, opt_state = adam_update(ocfg, params, grads, opt_state)
         return params, opt_state, loss
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    if not zero1:
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    param_sh = model.shardings(mesh)
+    moment_sh = (moment_shardings if moment_shardings is not None
+                 else zero1_moment_shardings(model, mesh))
+    scalar = NamedSharding(mesh, P())
+    opt_sh = AdamState(step=scalar, mu=moment_sh, nu=moment_sh)
+    return jax.jit(step, donate_argnums=(0, 1),
+                   out_shardings=(param_sh, opt_sh, scalar))
 
 
 def build_eval_loss(model: Transformer, mesh, loss_mode: str = "vocab_parallel"):
