@@ -10,14 +10,12 @@ Capability parity with `/root/reference/test.py`, with its defects fixed:
 * its validation "avg loss" divides a sum of per-batch means by the dataset
   size (`test.py:80`), correct only because bs=1 — here it divides by the
   number of batches;
-* its greedy decode re-runs a growing full-sequence forward every token
-  (`test.py:145-152`), a fresh CUDA graph per length; under XLA that would
-  recompile per length, so decoding uses ONE fixed-shape jitted step over a
-  padded buffer (causality makes the padding invisible to position < cur_len)
-  — compiled once, reused for every token of every prompt.
-
-Like the reference there is no KV cache (SURVEY §7 non-goals); each step is a
-full forward at the padded length.
+* its greedy decode re-runs a growing full-sequence forward every token with
+  no KV cache (`test.py:145-152`). The default decoder here is the KV-cache
+  prefill+step path (models/decode.py): one fixed-shape compile, O(t) per
+  token. `--no_kv_cache` selects the reference-parity full-recompute path
+  (still a single fixed-shape jitted step over a padded buffer, since
+  per-length recompiles would be pathological under XLA).
 """
 
 from __future__ import annotations
@@ -33,6 +31,7 @@ import numpy as np
 from .config import (BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MeshConfig,
                      ModelConfig)
 from .data.dataset import get_dataloader
+from .models.decode import GreedyDecoder
 from .models.transformer import Transformer
 from .runtime.mesh import make_mesh
 from .training.checkpoint import list_checkpoints, load_checkpoint
@@ -75,6 +74,9 @@ def get_eval_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("decode")
     g.add_argument("--max_decode_len", type=int, default=128)
+    g.add_argument("--no_kv_cache", action="store_true",
+                   help="use the reference-parity full-recompute decode "
+                        "instead of the KV-cache decoder (models/decode.py)")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -110,29 +112,37 @@ def make_greedy_decoder(model: Transformer, mesh, buf_len: int):
 
 def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
                   bos_id: int, eos_id: int,
-                  max_decode_len: int = 128) -> List[Tuple[str, str]]:
+                  max_decode_len: int = 128,
+                  use_kv_cache: bool = True) -> List[Tuple[str, str]]:
     encoded = {t.strip(): tokenizer.encode(t.strip()).ids for t in prompts}
     # one fixed buffer for every prompt (single compile); leave room for BOS
     # and at least one generated token even if a prompt is near the cap
     buf_len = max(max_decode_len + 1, max(len(i) for i in encoded.values()) + 2)
-    step = make_greedy_decoder(model, mesh, buf_len)
+    decoder = (GreedyDecoder(model, mesh, buf_len) if use_kv_cache
+               else None)
+    step = None if use_kv_cache else make_greedy_decoder(model, mesh, buf_len)
     out = []
     for text in prompts:
         text = text.strip()
         ids = encoded[text]
-        buf = np.full((1, buf_len), eos_id, dtype=np.int32)
-        buf[0, 0] = bos_id
-        buf[0, 1 : len(ids) + 1] = ids
-        cur = len(ids) + 1
-        # stop when total length (incl. BOS) exceeds max_decode_len, like the
-        # reference (`test.py:152`), or the buffer fills
-        while cur < buf_len and cur <= max_decode_len:
-            nxt = int(step(params, jnp.asarray(buf), cur))
-            if nxt == eos_id:
-                break
-            buf[0, cur] = nxt
-            cur += 1
-        decoded = tokenizer.decode(buf[0, 1:cur].tolist()).strip()
+        if use_kv_cache:
+            gen = decoder.decode(params, [bos_id] + ids, eos_id,
+                                 max_total_len=max_decode_len + 1)
+            decoded = tokenizer.decode(ids + gen).strip()
+        else:
+            buf = np.full((1, buf_len), eos_id, dtype=np.int32)
+            buf[0, 0] = bos_id
+            buf[0, 1 : len(ids) + 1] = ids
+            cur = len(ids) + 1
+            # stop when total length (incl. BOS) exceeds max_decode_len, like
+            # the reference (`test.py:152`), or the buffer fills
+            while cur < buf_len and cur <= max_decode_len:
+                nxt = int(step(params, jnp.asarray(buf), cur))
+                if nxt == eos_id:
+                    break
+                buf[0, cur] = nxt
+                cur += 1
+            decoded = tokenizer.decode(buf[0, 1:cur].tolist()).strip()
         # The decode must extend the prompt (reference asserts this,
         # test.py:159, and crashes when the tokenizer's vocab cannot
         # round-trip a prompt byte — e.g. punctuation unseen in training).
@@ -194,7 +204,8 @@ def evaluate(args: argparse.Namespace) -> dict:
     assert tokenizer.token_to_id(BOS_TOKEN) == bos_id
     assert tokenizer.token_to_id(EOS_TOKEN) == eos_id
     decoded = greedy_decode(model, mesh, params, tokenizer, DECODE_PROMPTS,
-                            bos_id, eos_id, args.max_decode_len)
+                            bos_id, eos_id, args.max_decode_len,
+                            use_kv_cache=not args.no_kv_cache)
     with open(report_path, "a") as f:
         f.write("\n\nInput texts -> Decoded texts\n")
         for prompt, completion in decoded:

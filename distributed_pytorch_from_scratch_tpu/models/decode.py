@@ -1,0 +1,225 @@
+"""KV-cache autoregressive decoding — one XLA program per generation.
+
+The reference has NO KV cache: its greedy decode re-runs the full growing
+sequence through the model for every generated token
+(`/root/reference/test.py:141-161`; SURVEY §7 lists "KV cache" as a
+reference non-goal). This module is the TPU-native upgrade, two levels deep:
+
+1. **KV cache**: a prefill pass over the padded prompt buffer produces
+   per-layer K/V tensors; each generated token then costs a single-token
+   forward against the cache — O(t) per token instead of O(t^2).
+2. **On-device generation loop**: prefill + a `lax.while_loop` of
+   single-token steps + greedy argmax + per-row EOS early-exit all compile
+   into ONE dispatch (`make_generate`). A host-driven token loop pays a full
+   host->device round-trip per token (~80 ms over the axon tunnel — measured
+   to dwarf the 45M model's ~1.7 ms of per-token compute); the fused loop
+   runs at device speed and returns once per prompt.
+
+Layout: caches are (num_layers, b, local_heads, buf_len, head_dim), sharded
+over 'tp' on the heads dim — the same head partitioning as training, so the
+same checkpoint params work unchanged. Decode is TP-only (dp=cp=1), like the
+reference's eval (`test.py` runs the TP mesh it trained with).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import resolve_dtype
+from ..ops.attention import MASK_VALUE, causal_attention
+from ..ops.collectives import gather_from
+from ..ops.rope import apply_rotary, rope_tables
+from .transformer import NEG_INF, Transformer
+
+Params = Dict[str, Any]
+
+
+def _qkv(model: Transformer, lp: Params, y: jax.Array, dtype):
+    """Project y (b, t, d) -> per-head q, k, v (b, local_heads, t, hd)."""
+    m = model._mods
+    b, t, _ = y.shape
+    h = model.cfg.head_dim
+    split = lambda z: z.reshape(b, t, model.num_local_heads, h).transpose(0, 2, 1, 3)
+    q = split(m["wq"].apply(lp["wq"], y, dtype))
+    k = split(m["wk"].apply(lp["wk"], y, dtype))
+    v = split(m["wv"].apply(lp["wv"], y, dtype))
+    return q, k, v
+
+
+def _finish_block(model: Transformer, lp: Params, x: jax.Array,
+                  o: jax.Array, dtype) -> jax.Array:
+    """Residual + wo, then the FFN sublayer (shared by prefill and decode)."""
+    m = model._mods
+    b, t = x.shape[0], x.shape[1]
+    o = o.transpose(0, 2, 1, 3).reshape(b, t, model.num_local_heads * model.cfg.head_dim)
+    x = x + m["wo"].apply(lp["wo"], o, dtype)
+    y = m["norm2"].apply(lp["norm2"], x)
+    g = m["gate_proj"].apply(lp["gate_proj"], y, dtype)
+    u = m["up_proj"].apply(lp["up_proj"], y, dtype)
+    return x + m["down_proj"].apply(lp["down_proj"], jax.nn.silu(g) * u, dtype)
+
+
+def _logits_last(model: Transformer, params: Params, x_last: jax.Array,
+                 dtype) -> jax.Array:
+    """Final norm + lm_head on (b, 1, d); returns the LOCAL vocab shard
+    (b, vocab_padded/tp) with padded columns masked (mirrors forward_shard)."""
+    x = model.final_norm.apply(params["norm"], x_last)
+    logits = model.lm_head.apply(params["lm_head"], x, dtype)[:, 0, :]
+    if model.vocab_padded != model.cfg.vocab_size:
+        local_v = logits.shape[-1]
+        start = lax.axis_index("tp") * local_v
+        col = start + jnp.arange(local_v)
+        logits = jnp.where(col[None, :] < model.cfg.vocab_size, logits,
+                           jnp.asarray(NEG_INF, logits.dtype))
+    return logits
+
+
+def _prefill(model: Transformer, params: Params, buf: jax.Array,
+             prompt_len: jax.Array, cos_t, sin_t, dtype):
+    """Causal full-buffer forward: returns (ks, vs) stacked per layer and the
+    logits at position prompt_len-1. Same `causal_attention` kernel as
+    training (flash on TPU). K/V of positions >= prompt_len hold padding —
+    they are re-written by decode steps before any query can attend to them."""
+    b, t = buf.shape
+    x = model.embedding.apply(params["embedding"], buf).astype(dtype)
+    pos = jnp.tile(jnp.arange(t, dtype=jnp.int32)[None, :], (b, 1))
+    cos = jnp.take(cos_t, pos, axis=0, mode="clip")
+    sin = jnp.take(sin_t, pos, axis=0, mode="clip")
+
+    def body(x, lp):
+        y = model._mods["norm1"].apply(lp["norm1"], x)
+        q, k, v = _qkv(model, lp, y, dtype)
+        q, k = apply_rotary(q, k, cos, sin)
+        o = causal_attention(q, k, v, impl=model.attn_impl)
+        x = _finish_block(model, lp, x, o, dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    last = lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)
+    return ks.astype(dtype), vs.astype(dtype), _logits_last(model, params, last, dtype)
+
+
+def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
+                token: jax.Array, cur: jax.Array, buf_len: int,
+                cos_t, sin_t, dtype):
+    """One single-token step at position `cur`: writes the token's K/V into
+    the caches, attends over cache[0..cur], returns (k', v', logits)."""
+    b = token.shape[0]
+    x = model.embedding.apply(params["embedding"], token[:, None]).astype(dtype)
+    p1 = jnp.full((b, 1), cur, jnp.int32)
+    cos = jnp.take(cos_t, p1, axis=0, mode="clip")
+    sin = jnp.take(sin_t, p1, axis=0, mode="clip")
+    visible = (jnp.arange(buf_len) <= cur)[None, None, None, :]
+
+    def body(x, layer_in):
+        lp, k_cache, v_cache = layer_in
+        y = model._mods["norm1"].apply(lp["norm1"], x)
+        q, k, v = _qkv(model, lp, y, dtype)              # (b, h, 1, hd)
+        q, k = apply_rotary(q, k, cos, sin)
+        k_cache = lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), cur, axis=2)
+        v_cache = lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), cur, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache,
+                       preferred_element_type=jnp.float32)
+        s = s / jnp.sqrt(jnp.asarray(model.cfg.head_dim, jnp.float32))
+        s = jnp.where(visible, s, MASK_VALUE)
+        p = jax.nn.softmax(s, axis=-1).astype(dtype)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p, v_cache)
+        x = _finish_block(model, lp, x, o, dtype)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache_k, cache_v))
+    return k_new, v_new, _logits_last(model, params, x, dtype)
+
+
+def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
+    """Whole-generation XLA program: jitted
+    (params, buf(b, buf_len), prompt_len, eos_id, max_total_len)
+      -> (buf with generated tokens written, per-row total length (b,)).
+
+    Greedy (argmax) decoding; rows that emit EOS stop contributing to their
+    length and are padded with eos_id while other rows finish. One compile
+    serves every prompt (prompt_len/eos/limit are traced scalars)."""
+    cfg = model.cfg
+    dtype = resolve_dtype(cfg.compute_dtype)
+
+    def shard_fn(params, buf, prompt_len, eos_id, max_total_len):
+        b, _ = buf.shape
+        cos_t, sin_t = rope_tables(cfg.maxlen, cfg.head_dim, cfg.rope_theta)
+        ks, vs, logits = _prefill(model, params, buf, prompt_len,
+                                  cos_t, sin_t, dtype)
+
+        def next_token(logits):
+            # global argmax across the tp vocab shards; pmax of the identical
+            # per-shard result makes it invariant over tp for the buf carry
+            full = gather_from(logits.astype(jnp.float32), "tp")
+            idx = jnp.argmax(full[:, : cfg.vocab_size], axis=-1).astype(jnp.int32)
+            return lax.pmax(idx, "tp")
+
+        limit = jnp.minimum(max_total_len, buf_len)
+        nxt = next_token(logits)
+        done0 = nxt == eos_id
+        gen0 = jnp.zeros((b,), jnp.int32)
+        carry0 = (buf, ks, vs, nxt, done0, gen0, prompt_len)
+
+        def cond(c):
+            _, _, _, _, done, _, cur = c
+            return jnp.logical_and(cur < limit, ~jnp.all(done))
+
+        def body(c):
+            buf, ck, cv, nxt, done, gen, cur = c
+            tok = jnp.where(done, eos_id, nxt)
+            gen = gen + jnp.where(done, 0, 1)
+            buf = lax.dynamic_update_slice(buf, tok[:, None], (0, cur))
+            ck, cv, logits = _decode_one(model, params, ck, cv, tok, cur,
+                                         buf_len, cos_t, sin_t, dtype)
+            nxt = next_token(logits)
+            done = jnp.logical_or(done, nxt == eos_id)
+            return (buf, ck, cv, nxt, done, gen, cur + 1)
+
+        buf, _, _, _, _, gen, _ = lax.while_loop(cond, body, carry0)
+        return buf, prompt_len + gen  # per-row total length
+
+    fn = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(model.specs(), P(None, None), P(), P(), P()),
+        out_specs=(P(None, None), P(None)))
+    return jax.jit(fn)
+
+
+class GreedyDecoder:
+    """KV-cache greedy decoder: compile the whole-generation program ONCE,
+    reuse across prompts (the reference re-runs O(t^2) work per token,
+    `test.py:145-152`; the no-cache jitted path in evaluate.py is
+    O(buf_len^2) per token AND pays one dispatch per token)."""
+
+    def __init__(self, model: Transformer, mesh: Mesh, buf_len: int):
+        if model.cp_size != 1:
+            raise ValueError("decode is TP-only; build the decoder with a "
+                             "cp_size=1 model (same params load fine)")
+        self.model = model
+        self.mesh = mesh
+        self.buf_len = buf_len
+        self.generate = make_generate(model, mesh, buf_len)
+
+    def decode(self, params, prompt_ids, eos_id: int,
+               max_total_len: int) -> list:
+        """Greedy-decode one prompt (ids incl. BOS); returns generated ids
+        (prompt excluded), stopping at EOS or `max_total_len` total tokens.
+        One device dispatch for the whole generation."""
+        import numpy as np
+
+        buf = np.full((1, self.buf_len), eos_id, dtype=np.int32)
+        buf[0, : len(prompt_ids)] = prompt_ids
+        plen = len(prompt_ids)
+        buf, flen = self.generate(params, jnp.asarray(buf),
+                                  jnp.asarray(plen, jnp.int32),
+                                  jnp.asarray(eos_id, jnp.int32),
+                                  jnp.asarray(max_total_len, jnp.int32))
+        return np.asarray(buf)[0, plen : int(flen[0])].tolist()

@@ -233,7 +233,7 @@ def train(args: argparse.Namespace) -> dict:
             break
 
     final_avg = float(accum_loss) / max(n - start_step, 1)
-    profiler.close()
+    profiler.close(sync=accum_loss)
     writer.close()
     print(f"training finished at step {n}, avg loss {final_avg:.4f}")
     return {"steps": n, "avg_loss": final_avg}

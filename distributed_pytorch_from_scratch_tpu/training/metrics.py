@@ -106,10 +106,15 @@ class ProfilerTrace:
             self._active = False
             print(f"profiler trace written to {self.log_dir}")
 
-    def close(self) -> None:
+    def close(self, sync=None) -> None:
         if self._active:
+            if sync is not None:
+                jax.block_until_ready(sync)
             jax.profiler.stop_trace()
             self._active = False
+            print(f"profiler trace written to {self.log_dir} (window "
+                  f"overlapped the end of training; it may cover fewer "
+                  f"steps than requested)")
 
 
 def device_memory_gib(device: Optional[jax.Device] = None) -> float:
