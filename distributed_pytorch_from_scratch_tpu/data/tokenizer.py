@@ -59,19 +59,42 @@ def train_bpe(data_path: str, output_path: str, vocab_size: int = 30000,
 
 
 def pre_tokenize(input_file: str, output_file: str, tokenizer_file: str,
-                 splits: Iterable[str] = ("train", "validation")) -> Dict:
+                 splits: Iterable[str] = ("train", "validation"),
+                 backend: str = "auto") -> Dict:
     """Apply a saved tokenizer to each split; write token-id JSON
-    (reference `pre_tokenize.py:20-52`)."""
+    (reference `pre_tokenize.py:20-52`).
+
+    backend: 'native' (the framework's C++ BPE, csrc/dataloader.cpp),
+    'hf' (the HF tokenizers library the reference uses), or 'auto' — native
+    when it builds AND passes its load-time parity self-check, else hf.
+    """
     from tokenizers import Tokenizer
 
     with open(input_file) as f:
         data = json.load(f)
     tokenizer = Tokenizer.from_file(tokenizer_file)
 
+    native = None
+    if backend in ("auto", "native"):
+        try:
+            from .native import NativeBPE
+            native = NativeBPE(tokenizer_file,
+                               extra_probes=[t for split in splits
+                                             for t in data[split][:64]])
+            print("pre_tokenize: using native C++ BPE encoder")
+        except Exception as e:
+            if backend == "native":
+                raise
+            print(f"pre_tokenize: native encoder unavailable ({e}); "
+                  f"falling back to HF tokenizers")
+
     out: Dict = {}
     for split in splits:
-        encoded = tokenizer.encode_batch(data[split])
-        out[split] = [e.ids for e in encoded]
+        if native is not None:
+            out[split] = [native.encode(t) for t in data[split]]
+        else:
+            encoded = tokenizer.encode_batch(data[split])
+            out[split] = [e.ids for e in encoded]
         lens = [len(ids) for ids in out[split]] or [0]
         print(f"pre_tokenize: {split}: n={len(out[split])} "
               f"max={max(lens)} avg={sum(lens)/max(len(lens),1):.2f}")
@@ -102,13 +125,15 @@ def main(argv=None):
     e.add_argument("--output_file", "-o", required=True)
     e.add_argument("--tokenizer_file", "-t", required=True)
     e.add_argument("--splits", "-s", nargs="+", default=["train", "validation"])
+    e.add_argument("--backend", choices=["auto", "native", "hf"],
+                   default="auto")
 
     args = p.parse_args(argv)
     if args.cmd == "train":
         train_bpe(args.data_path, args.output_path, args.vocab_size)
     else:
         pre_tokenize(args.input_file, args.output_file, args.tokenizer_file,
-                     args.splits)
+                     args.splits, backend=args.backend)
 
 
 if __name__ == "__main__":
