@@ -31,7 +31,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 MASK = -1e30  # hard mask; equivalent to the XLA path's -10000 (see module doc)
 
-DEFAULT_BLOCK_Q = 512
+# Swept on v5e at the reference shape (b*h=256, t=1000->1024, hd=64):
+# 1024x1024 runs the fwd kernel 2.0x and fwd+bwd 1.8x faster than the
+# previous 512x1024 default (2.45ms vs 4.93ms fwd; 5.77ms vs 10.58ms
+# fwd+bwd per layer) — fewer grid steps amortize the VMEM pipeline better
+# at these small head dims. Blocks clamp to the padded sequence length, so
+# shorter sequences are unaffected.
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
