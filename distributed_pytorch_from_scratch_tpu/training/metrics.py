@@ -117,6 +117,33 @@ class ProfilerTrace:
                   f"steps than requested)")
 
 
+def allreduce_p50_us(mesh, axis: str = "tp", nbytes: int = 4 * 1024 * 1024,
+                     iters: int = 30) -> float:
+    """p50 latency of a single all-reduce over `axis` (BASELINE metric #2).
+
+    Shared by `bench.py` (real ICI number when tp > 1) and
+    `__graft_entry__.dryrun_multichip` (virtual-CPU correctness-grade
+    number). Timing syncs via `.item()` D2H copy — `block_until_ready`
+    returns early for chained executions on the axon platform.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.collectives import reduce_from
+
+    x = jnp.ones((nbytes // 4,), jnp.float32)
+    f = jax.jit(jax.shard_map(lambda x: reduce_from(x, axis), mesh=mesh,
+                              in_specs=(P(),), out_specs=P()))
+    jax.block_until_ready(f(x))  # compile
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        f(x)[0].item()  # D2H sync
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2] * 1e6
+
+
 def device_memory_gib(device: Optional[jax.Device] = None) -> float:
     """Bytes in use on the device, in GiB (analogue of
     `torch.cuda.memory_reserved`, reference `train.py:119`)."""
