@@ -1,10 +1,17 @@
 """Benchmark harness. Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Measures training throughput of the reference-scale GPT (45M params,
-`/root/reference/constants.py:9-17`) at the reference's experiment scale
-(batch 32, seqlen 1000, bf16 — `train.py:41`, `recipe.sh`) on the available
-device(s): TP over all local chips (1 chip under the bench driver).
+Default run (what the driver executes): training throughput of the
+reference-scale GPT (45M params, `/root/reference/constants.py:9-17`) at the
+reference's experiment scale (batch 32, seqlen 1000, bf16 — `train.py:41`,
+`recipe.sh`) on the available device(s): TP over all local chips (1 chip
+under the bench driver).
+
+Flags cover the other BASELINE.md configs:
+    --model {45m,gpt2-124m,tiny}   model preset (BASELINE configs 1/3)
+    --remat {true,dots,false}      rematerialisation policy
+    --batch N --seqlen N           override the experiment shape
+    --dp N --tp N                  mesh axes (world = dp*tp must match chips)
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the
 driver-assigned north star is used — MFU >= 30% on TPU. vs_baseline is
@@ -13,6 +20,7 @@ measured_MFU / 0.30 (1.0 == target met).
 Extra diagnostics (tp all-reduce p50 latency, MFU, memory) go to stderr.
 """
 
+import argparse
 import json
 import sys
 import time
@@ -22,48 +30,49 @@ import jax.numpy as jnp
 
 from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
                                                   Transformer, make_mesh)
-from distributed_pytorch_from_scratch_tpu.config import OptimizerConfig
-from distributed_pytorch_from_scratch_tpu.ops.collectives import reduce_from
+from distributed_pytorch_from_scratch_tpu.config import (REMAT_CHOICES,
+                                                         OptimizerConfig,
+                                                         model_preset)
 from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
 from distributed_pytorch_from_scratch_tpu.training.metrics import (
-    chip_peak_flops, model_flops_per_step)
+    allreduce_p50_us, chip_peak_flops, device_memory_gib, model_flops_per_step)
 from distributed_pytorch_from_scratch_tpu.training.train_step import (
     build_train_step)
 
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="45m",
+                   choices=["45m", "gpt2-124m", "tiny"])
+    # "dots" saves matmul outputs + the flash kernel's o/lse residuals
+    # (models/transformer.py); measured faster than full remat at every
+    # config that fits, and the 45M b32xt1000 run fits on a 16G chip.
+    p.add_argument("--remat", default="dots", choices=sorted(REMAT_CHOICES))
+    p.add_argument("--batch", type=int, default=None,
+                   help="default: 32 (reference train.py:41), 8 for gpt2-124m")
+    p.add_argument("--seqlen", type=int, default=None,
+                   help="default: model maxlen (1000 for 45m)")
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=0,
+                   help="0 = all remaining local chips")
+    p.add_argument("--iters", type=int, default=8)
+    return p.parse_args(argv)
 
 
-def allreduce_p50_us(mesh, tp: int, nbytes: int = 4 * 1024 * 1024,
-                     iters: int = 30) -> float:
-    """TP all-reduce p50 latency over ICI (BASELINE.json metric #2)."""
-    from jax.sharding import PartitionSpec as P
-    n = nbytes // 4
-    x = jnp.ones((n,), jnp.float32)
-
-    f = jax.jit(jax.shard_map(lambda x: reduce_from(x, "tp"), mesh=mesh,
-                              in_specs=(P(),), out_specs=P()))
-    jax.block_until_ready(f(x))  # compile
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        np_sync = f(x)[0].item()  # D2H sync (block_until_ready unreliable on axon)
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2] * 1e6
-
-
-def main():
+def main(argv=None):
+    args = parse_args(argv)
     n_dev = jax.device_count()
-    tp = n_dev  # TP over all local chips (reference runs pure TP)
-    mesh = make_mesh(MeshConfig(dp=1, tp=tp))
-    cfg = ModelConfig(compute_dtype="bfloat16")
-    model = Transformer(cfg, tp_size=tp)
+    tp = args.tp or max(1, n_dev // args.dp)
+    mesh = make_mesh(MeshConfig(dp=args.dp, tp=tp))
+    cfg = model_preset(args.model, compute_dtype="bfloat16")
+    model = Transformer(cfg, tp_size=tp, remat=REMAT_CHOICES[args.remat])
     params = jax.device_put(model.init(jax.random.key(0)),
                             model.shardings(mesh))
     opt_state = init_adam_state(params)
     ocfg = OptimizerConfig()
     step_fn = build_train_step(model, mesh, ocfg)
 
-    B, T = 32, cfg.maxlen
+    B = args.batch or (8 if args.model == "gpt2-124m" else 32)
+    T = args.seqlen or cfg.maxlen
     key = jax.random.key(1)
     ids = jax.random.randint(key, (B, T), 0, cfg.vocab_size)
     tgt = jnp.roll(ids, -1, axis=1)
@@ -78,7 +87,7 @@ def main():
     float(loss)
     compile_s = time.time() - t0
 
-    warm, iters = 2, 8
+    warm, iters = 2, args.iters
     for _ in range(warm):
         params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
         float(loss)
@@ -88,21 +97,32 @@ def main():
     float(loss)
     step_s = (time.time() - t0) / iters
 
-    tokens_per_sec_per_chip = B * T / step_s / n_dev
+    world = args.dp * tp
+    tokens_per_sec_per_chip = B * T / step_s / world
 
     flops_per_step = model_flops_per_step(cfg, B, T)
-    mfu = flops_per_step / step_s / (chip_peak_flops() * n_dev)
+    mfu = flops_per_step / step_s / (chip_peak_flops() * world)
 
-    p50 = allreduce_p50_us(mesh, tp) if tp > 1 else None
+    p50 = allreduce_p50_us(mesh, "tp") if tp > 1 else None
 
-    print(f"bench: {n_dev} device(s) [{jax.devices()[0].device_kind}], "
-          f"compile {compile_s:.1f}s, step {step_s*1000:.1f}ms, "
-          f"loss {float(loss):.4f}, MFU {mfu*100:.1f}%"
+    # BASELINE config 4 note: the vocab-parallel CE (the train step's default
+    # loss mode) never materialises the full (B, T, V) logits; the f32 gather
+    # it avoids at this config would be:
+    vp = cfg.padded_vocab_size(tp)
+    print(f"bench: vocab-parallel CE avoids a {B}x{T}x{vp} f32 logits "
+          f"gather ({B * T * vp * 4 / 2**30:.2f} GiB at this config; "
+          f"tested in tests/test_large_vocab.py)", file=sys.stderr)
+
+    print(f"bench[{args.model}, remat={args.remat}]: {world} device(s) "
+          f"[{jax.devices()[0].device_kind}], compile {compile_s:.1f}s, "
+          f"step {step_s*1000:.1f}ms, loss {float(loss):.4f}, "
+          f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f}GiB"
           + (f", tp all-reduce p50 {p50:.0f}us (4MiB)" if p50 else ""),
           file=sys.stderr)
 
     print(json.dumps({
-        "metric": f"tokens/sec/chip (45M GPT, bf16, b{B}xt{T}, tp={tp})",
+        "metric": (f"tokens/sec/chip ({args.model} GPT, bf16, b{B}xt{T}, "
+                   f"dp={args.dp}, tp={tp}, remat={args.remat})"),
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu / 0.30, 4),

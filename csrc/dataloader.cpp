@@ -315,17 +315,21 @@ void collate_batch(const int32_t* flat, const int32_t* lens, int32_t batch,
     int64_t off = 0;
     for (int32_t i = 0; i < batch; ++i) {
         int32_t L = lens[i];
+        // Defensive clamp: a row longer than width-1 must not write past the
+        // row (callers validate width >= max(len)+1, but an unchecked width
+        // would otherwise be a heap overflow, not a wrong answer).
+        int32_t Lc = L < width - 1 ? L : width - 1;
         int32_t* in = input_ids + (int64_t)i * width;
         int32_t* tg = target_ids + (int64_t)i * width;
         int32_t* ps = position_ids + (int64_t)i * width;
         in[0] = bos;
-        for (int32_t j = 0; j < L; ++j) {
+        for (int32_t j = 0; j < Lc; ++j) {
             in[j + 1] = flat[off + j];
             tg[j] = flat[off + j];
         }
-        for (int32_t j = L + 1; j < width; ++j) in[j] = eos;
-        tg[L] = eos;
-        for (int32_t j = L + 1; j < width; ++j) tg[j] = ignore;
+        for (int32_t j = Lc + 1; j < width; ++j) in[j] = eos;
+        tg[Lc] = eos;
+        for (int32_t j = Lc + 1; j < width; ++j) tg[j] = ignore;
         for (int32_t j = 0; j < width; ++j) ps[j] = j;
         off += L;
     }

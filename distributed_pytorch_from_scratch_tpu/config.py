@@ -80,6 +80,31 @@ class ModelConfig:
         return v * d + L * (attn + ffn + norms) + d + v * d + v  # emb + layers + final norm + lm_head
 
 
+# CLI flag-string -> Transformer.remat value (shared by train.py/bench.py)
+REMAT_CHOICES = {"true": True, "dots": "dots", "false": False}
+
+# Named model presets (BASELINE.md "configs to cover"). "45m" is the
+# reference's exact shape (`/root/reference/constants.py:9-17`); "gpt2-124m"
+# is BASELINE config 3 (GPT-2 small: d=768, 12 heads/layers, vocab 50257,
+# ctx 1024 — untied lm_head like the reference, so ~190M actual params);
+# "tiny" is BASELINE config 1 (2-layer d_model=128 GPT for CPU smoke runs).
+MODEL_PRESETS = {
+    "45m": ModelConfig(),
+    "gpt2-124m": ModelConfig(attn_dim=768, ffn_dim=3072, num_heads=12,
+                             num_layers=12, vocab_size=50257, maxlen=1024),
+    "tiny": ModelConfig(attn_dim=128, ffn_dim=512, num_heads=4,
+                        num_layers=2, vocab_size=1024, maxlen=256),
+}
+
+
+def model_preset(name: str, **overrides) -> ModelConfig:
+    if name not in MODEL_PRESETS:
+        raise ValueError(
+            f"unknown model preset {name!r}; expected one of "
+            f"{sorted(MODEL_PRESETS)}")
+    return dataclasses.replace(MODEL_PRESETS[name], **overrides)
+
+
 @dataclass(frozen=True)
 class MeshConfig:
     """3-D device mesh: ('dp', 'cp', 'tp').

@@ -92,6 +92,11 @@ class DataLoader:
     (`dataset.py:58-68`) minus torch. `drop_last=True` for training keeps
     every batch the same shape (no recompiles); the reference's final partial
     batch is instead carried into the next epoch's order.
+
+    `backend` selects the collate implementation like the tokenizer's
+    backend flag: 'native' = the C++ `collate_batch` (csrc/dataloader.cpp),
+    'numpy' = the pure-Python path, 'auto' = native when the library builds
+    (byte-equality of the two is asserted in tests/test_native_data.py).
     """
 
     dataset: TokenDataset
@@ -101,6 +106,28 @@ class DataLoader:
     seed: int = 0
     pad_to: Optional[int] = None
     drop_last: bool = True
+    backend: str = "auto"
+
+    def __post_init__(self):
+        if self.backend not in ("auto", "native", "numpy"):
+            raise ValueError(f"backend must be auto|native|numpy, "
+                             f"got {self.backend!r}")
+        use_native = False
+        if self.backend in ("auto", "native"):
+            from .native import native_available
+            use_native = native_available()
+            if self.backend == "native" and not use_native:
+                raise RuntimeError("native collate requested but the C++ "
+                                   "library is unavailable")
+        self._use_native = use_native
+
+    def _collate(self, batch: List[List[int]]) -> Dict[str, np.ndarray]:
+        ds = self.dataset
+        if self._use_native:
+            from .native import native_collate
+            return native_collate(batch, ds.bos, ds.eos, self.ignore_idx,
+                                  self.pad_to)
+        return collate(batch, ds.bos, ds.eos, self.ignore_idx, self.pad_to)
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -117,8 +144,7 @@ class DataLoader:
         for st in range(0, end, bs):
             idxs = order[st : st + bs]
             batch = [self.dataset[int(i)] for i in idxs]
-            yield collate(batch, self.dataset.bos, self.dataset.eos,
-                          self.ignore_idx, self.pad_to)
+            yield self._collate(batch)
 
     def __iter__(self):
         return self.epoch(0)
@@ -128,7 +154,8 @@ def get_dataloader(data_path: str, batch_size: int,
                    ignore_idx: int = IGNORE_INDEX, split: str = "train",
                    maxlen: int = 1000, shuffle: bool = True, seed: int = 0,
                    pad_to: Optional[int] = None,
-                   drop_last: Optional[bool] = None) -> DataLoader:
+                   drop_last: Optional[bool] = None,
+                   backend: str = "auto") -> DataLoader:
     """Reference-parity factory (`dataset.py:58-68`)."""
     ds = TokenDataset(data_path, split, maxlen)
     if pad_to is None:
@@ -136,4 +163,4 @@ def get_dataloader(data_path: str, batch_size: int,
     if drop_last is None:
         drop_last = split == "train"
     return DataLoader(ds, batch_size, ignore_idx, shuffle, seed, pad_to,
-                      drop_last)
+                      drop_last, backend)

@@ -106,9 +106,14 @@ class NativeBPE:
         self.add_prefix_space = bool(pre.get("add_prefix_space", False))
 
         vocab = spec["model"]["vocab"]
-        # added tokens (BOS/EOS/UNK) participate only as whole strings; the
-        # encode path never produces them from text, matching HF on normal
-        # text (reference feeds specials via collate, not the tokenizer)
+        # Added tokens (BOS/EOS/UNK) participate only as whole strings; the
+        # encode path never produces them from text (the reference feeds
+        # specials via collate, not the tokenizer). HF *does* match a
+        # literal added-token string appearing in raw text, so callers must
+        # route such corpora to the HF path — `added_tokens` is exposed for
+        # that scan (see data.tokenizer.pre_tokenize; ADVICE r1).
+        self.added_tokens = [at["content"]
+                             for at in spec.get("added_tokens", [])]
         toks = list(vocab.keys())
         ids = [vocab[t] for t in toks]
         merges = spec["model"]["merges"]
@@ -168,18 +173,27 @@ class NativeBPE:
 
 
 def native_collate(batch: List[List[int]], bos: int, eos: int,
-                   ignore_idx: int, width: int) -> dict:
+                   ignore_idx: int, width: Optional[int] = None) -> dict:
     """C++ collate with the reference's exact semantics
     (`/root/reference/dataset.py:40-55`); same output dict as
-    data.dataset.collate."""
+    data.dataset.collate. `width=None` pads to the longest row + 1, the same
+    default rule as `collate(pad_to=None)`."""
     lib = get_lib()
     if lib is None:
         raise RuntimeError(f"native library unavailable: {_lib_err}")
+    import itertools
+
     n = len(batch)
-    flat = np.ascontiguousarray(
-        np.concatenate([np.asarray(b, np.int32) for b in batch])
-        if batch and any(len(b) for b in batch) else np.zeros(0, np.int32))
-    lens = np.asarray([len(b) for b in batch], np.int32)
+    lens_py = list(map(len, batch))
+    longest = max(lens_py, default=0)
+    if width is None:
+        width = longest + 1
+    assert width >= longest + 1, (
+        f"pad width {width} < longest sequence + 1 ({longest + 1}); callers "
+        f"must truncate to width-1 first (dataset.TokenDataset does)")
+    flat = np.fromiter(itertools.chain.from_iterable(batch), np.int32,
+                       sum(lens_py))
+    lens = np.asarray(lens_py, np.int32)
     input_ids = np.empty((n, width), np.int32)
     target_ids = np.empty((n, width), np.int32)
     position_ids = np.empty((n, width), np.int32)

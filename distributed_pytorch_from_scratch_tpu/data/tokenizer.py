@@ -87,6 +87,21 @@ def pre_tokenize(input_file: str, output_file: str, tokenizer_file: str,
                 raise
             print(f"pre_tokenize: native encoder unavailable ({e}); "
                   f"falling back to HF tokenizers")
+    if native is not None and native.added_tokens:
+        # HF matches literal added-token strings (e.g. "<EOS>") inside raw
+        # text; the native scanner does not. Scan the WHOLE corpus — the old
+        # 64-samples-per-split probe let later occurrences diverge silently
+        # (ADVICE r1) — and route to HF when any occurrence exists.
+        hit = next((s for split in splits for t in data[split]
+                    for s in native.added_tokens if s in t), None)
+        if hit is not None:
+            if backend == "native":
+                raise ValueError(
+                    f"corpus contains the added-token string {hit!r}, which "
+                    f"the native encoder cannot match; use backend='hf'")
+            print(f"pre_tokenize: corpus contains added-token string "
+                  f"{hit!r}; using HF tokenizers for exact parity")
+            native = None
 
     out: Dict = {}
     for split in splits:

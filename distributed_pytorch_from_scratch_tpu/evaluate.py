@@ -28,8 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .config import (BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MeshConfig,
-                     ModelConfig)
+from .config import (BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MODEL_PRESETS,
+                     MeshConfig, ModelConfig, model_preset)
 from .data.dataset import get_dataloader
 from .models.decode import GreedyDecoder
 from .models.transformer import Transformer
@@ -64,11 +64,14 @@ def get_eval_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("model")
     g.add_argument("--ckpt_dir", required=True)
-    g.add_argument("--attn_dim", type=int, default=512)
-    g.add_argument("--ffn_dim", type=int, default=2048)
-    g.add_argument("--num_heads", type=int, default=8)
-    g.add_argument("--num_layers", type=int, default=12)
-    g.add_argument("--maxlen", type=int, default=1000)
+    g.add_argument("--model", choices=sorted(MODEL_PRESETS), default=None,
+                   help="named shape preset; must match the trained model "
+                        "(explicit dim flags override preset fields)")
+    g.add_argument("--attn_dim", type=int, default=None)
+    g.add_argument("--ffn_dim", type=int, default=None)
+    g.add_argument("--num_heads", type=int, default=None)
+    g.add_argument("--num_layers", type=int, default=None)
+    g.add_argument("--maxlen", type=int, default=None)
     g.add_argument("--bf16", action="store_true", default=True)
     g.add_argument("--no-bf16", dest="bf16", action="store_false")
 
@@ -114,22 +117,27 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
                   bos_id: int, eos_id: int,
                   max_decode_len: int = 128,
                   use_kv_cache: bool = True) -> List[Tuple[str, str]]:
-    encoded = {t.strip(): tokenizer.encode(t.strip()).ids for t in prompts}
+    texts = [t.strip() for t in prompts]
+    encoded = {t: tokenizer.encode(t).ids for t in texts}
     # one fixed buffer for every prompt (single compile); leave room for BOS
     # and at least one generated token even if a prompt is near the cap
     buf_len = max(max_decode_len + 1, max(len(i) for i in encoded.values()) + 2)
-    decoder = (GreedyDecoder(model, mesh, buf_len) if use_kv_cache
-               else None)
-    step = None if use_kv_cache else make_greedy_decoder(model, mesh, buf_len)
-    out = []
-    for text in prompts:
-        text = text.strip()
-        ids = encoded[text]
-        if use_kv_cache:
-            gen = decoder.decode(params, [bos_id] + ids, eos_id,
-                                 max_total_len=max_decode_len + 1)
-            decoded = tokenizer.decode(ids + gen).strip()
-        else:
+
+    if use_kv_cache:
+        # ONE device dispatch for the whole prompt set: decode_batch handles
+        # the mixed prompt lengths (models/decode.py). The reference loops
+        # prompts AND tokens (`test.py:141-161`).
+        decoder = GreedyDecoder(model, mesh, buf_len)
+        gens = decoder.decode_batch(
+            params, [[bos_id] + encoded[t] for t in texts], eos_id,
+            max_total_len=max_decode_len + 1)
+        decoded_texts = [tokenizer.decode(encoded[t] + gen).strip()
+                         for t, gen in zip(texts, gens)]
+    else:
+        step = make_greedy_decoder(model, mesh, buf_len)
+        decoded_texts = []
+        for text in texts:
+            ids = encoded[text]
             buf = np.full((1, buf_len), eos_id, dtype=np.int32)
             buf[0, 0] = bos_id
             buf[0, 1 : len(ids) + 1] = ids
@@ -142,7 +150,11 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
                     break
                 buf[0, cur] = nxt
                 cur += 1
-            decoded = tokenizer.decode(buf[0, 1:cur].tolist()).strip()
+            decoded_texts.append(tokenizer.decode(buf[0, 1:cur].tolist()).strip())
+
+    out = []
+    for text, decoded in zip(texts, decoded_texts):
+        ids = encoded[text]
         # The decode must extend the prompt (reference asserts this,
         # test.py:159, and crashes when the tokenizer's vocab cannot
         # round-trip a prompt byte — e.g. punctuation unseen in training).
@@ -163,14 +175,20 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
 def evaluate(args: argparse.Namespace) -> dict:
     from tokenizers import Tokenizer as HFTokenizer
 
+    preset = model_preset(args.model) if args.model else ModelConfig()
+    pick = lambda flag, dflt: dflt if flag is None else flag
+    maxlen = pick(args.maxlen, preset.maxlen)
+
     mesh = make_mesh(MeshConfig(dp=1, tp=args.tp_size))
     dataloader = get_dataloader(args.data_path, args.batch_size, IGNORE_INDEX,
-                                split="validation", maxlen=args.maxlen,
+                                split="validation", maxlen=maxlen,
                                 shuffle=False, drop_last=False)
     vocab_size = dataloader.dataset.vocab_size
-    cfg = ModelConfig(attn_dim=args.attn_dim, ffn_dim=args.ffn_dim,
-                      num_heads=args.num_heads, num_layers=args.num_layers,
-                      vocab_size=vocab_size, maxlen=args.maxlen,
+    cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
+                      ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
+                      num_heads=pick(args.num_heads, preset.num_heads),
+                      num_layers=pick(args.num_layers, preset.num_layers),
+                      vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
     model = Transformer(cfg, tp_size=args.tp_size)
     template = model.init(jax.random.key(args.random_seed))

@@ -82,9 +82,10 @@ def _logits_last(model: Transformer, params: Params, x_last: jax.Array,
 def _prefill(model: Transformer, params: Params, buf: jax.Array,
              prompt_len: jax.Array, cos_t, sin_t, dtype):
     """Causal full-buffer forward: returns (ks, vs) stacked per layer and the
-    logits at position prompt_len-1. Same `causal_attention` kernel as
-    training (flash on TPU). K/V of positions >= prompt_len hold padding —
-    they are re-written by decode steps before any query can attend to them."""
+    PER-ROW logits at position prompt_len[i]-1 (prompt_len: (b,)). Same
+    `causal_attention` kernel as training (flash on TPU). K/V of positions
+    >= prompt_len hold padding — they are re-written by decode steps before
+    any query can attend to them."""
     b, t = buf.shape
     x = model.embedding.apply(params["embedding"], buf).astype(dtype)
     pos = jnp.tile(jnp.arange(t, dtype=jnp.int32)[None, :], (b, 1))
@@ -100,7 +101,8 @@ def _prefill(model: Transformer, params: Params, buf: jax.Array,
         return x, (k, v)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
-    last = lax.dynamic_slice_in_dim(x, prompt_len - 1, 1, axis=1)
+    last = jnp.take_along_axis(
+        x, (prompt_len - 1)[:, None, None].astype(jnp.int32), axis=1)
     return ks.astype(dtype), vs.astype(dtype), _logits_last(model, params, last, dtype)
 
 
@@ -143,15 +145,28 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
     (params, buf(b, buf_len), prompt_len, eos_id, max_total_len)
       -> (buf with generated tokens written, per-row total length (b,)).
 
+    `prompt_len` may be a scalar (all rows share a length) or a (b,) vector
+    — mixed-length prompt batches decode in ONE dispatch. The loop cursor is
+    shared across rows ("teacher-forced catch-up"): it starts at
+    min(prompt_len), and a row whose prompt extends past the cursor re-feeds
+    its own prompt token (recomputing the K/V the prefill already wrote —
+    per-position activations under causal attention are context-past-only,
+    so the values are identical) until the cursor clears its prompt, after
+    which its argmax tokens are appended like the single-row case.
+
     Greedy (argmax) decoding; rows that emit EOS stop contributing to their
     length and are padded with eos_id while other rows finish. One compile
-    serves every prompt (prompt_len/eos/limit are traced scalars)."""
+    serves every prompt (prompt_len/eos/limit are traced)."""
     cfg = model.cfg
     dtype = resolve_dtype(cfg.compute_dtype)
+    # RoPE tables cover the whole decode buffer even past the model's
+    # trained maxlen (positions used to silently clip to the last table row
+    # when buf_len > maxlen — ADVICE r1).
+    table_len = max(cfg.maxlen, buf_len)
 
     def shard_fn(params, buf, prompt_len, eos_id, max_total_len):
         b, _ = buf.shape
-        cos_t, sin_t = rope_tables(cfg.maxlen, cfg.head_dim, cfg.rope_theta)
+        cos_t, sin_t = rope_tables(table_len, cfg.head_dim, cfg.rope_theta)
         ks, vs, logits = _prefill(model, params, buf, prompt_len,
                                   cos_t, sin_t, dtype)
 
@@ -163,10 +178,11 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
             return lax.pmax(idx, "tp")
 
         limit = jnp.minimum(max_total_len, buf_len)
-        nxt = next_token(logits)
-        done0 = nxt == eos_id
+        nxt = next_token(logits)                     # (b,) per-row first token
+        cur0 = jnp.min(prompt_len)
+        done0 = (prompt_len == cur0) & (nxt == eos_id)
         gen0 = jnp.zeros((b,), jnp.int32)
-        carry0 = (buf, ks, vs, nxt, done0, gen0, prompt_len)
+        carry0 = (buf, ks, vs, nxt, done0, gen0, cur0)
 
         def cond(c):
             _, _, _, _, done, _, cur = c
@@ -174,23 +190,35 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
 
         def body(c):
             buf, ck, cv, nxt, done, gen, cur = c
-            tok = jnp.where(done, eos_id, nxt)
-            gen = gen + jnp.where(done, 0, 1)
+            in_prompt = cur < prompt_len             # (b,)
+            cur_tok = lax.dynamic_slice_in_dim(buf, cur, 1, axis=1)[:, 0]
+            tok = jnp.where(in_prompt, cur_tok,
+                            jnp.where(done, eos_id, nxt))
+            gen = gen + jnp.where(in_prompt | done, 0, 1)
             buf = lax.dynamic_update_slice(buf, tok[:, None], (0, cur))
             ck, cv, logits = _decode_one(model, params, ck, cv, tok, cur,
                                          buf_len, cos_t, sin_t, dtype)
-            nxt = next_token(logits)
-            done = jnp.logical_or(done, nxt == eos_id)
-            return (buf, ck, cv, nxt, done, gen, cur + 1)
+            cand = next_token(logits)
+            # cand is consumed at position cur+1; it counts as a GENERATED
+            # token for a row only once the cursor has cleared its prompt
+            starts_gen = (cur + 1) >= prompt_len
+            done = done | (starts_gen & (cand == eos_id))
+            return (buf, ck, cv, cand, done, gen, cur + 1)
 
         buf, _, _, _, _, gen, _ = lax.while_loop(cond, body, carry0)
         return buf, prompt_len + gen  # per-row total length
 
     fn = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(model.specs(), P(None, None), P(), P(), P()),
+        in_specs=(model.specs(), P(None, None), P(None), P(), P()),
         out_specs=(P(None, None), P(None)))
-    return jax.jit(fn)
+
+    def wrapper(params, buf, prompt_len, eos_id, max_total_len):
+        prompt_len = jnp.broadcast_to(
+            jnp.asarray(prompt_len, jnp.int32), (buf.shape[0],))
+        return fn(params, buf, prompt_len, eos_id, max_total_len)
+
+    return jax.jit(wrapper)
 
 
 class GreedyDecoder:
@@ -213,13 +241,29 @@ class GreedyDecoder:
         """Greedy-decode one prompt (ids incl. BOS); returns generated ids
         (prompt excluded), stopping at EOS or `max_total_len` total tokens.
         One device dispatch for the whole generation."""
+        return self.decode_batch(params, [prompt_ids], eos_id,
+                                 max_total_len)[0]
+
+    def decode_batch(self, params, prompts, eos_id: int,
+                     max_total_len: int) -> list:
+        """Greedy-decode a LIST of prompts (mixed lengths fine) in a single
+        device dispatch; returns one generated-ids list per prompt. The
+        reference dispatches per prompt AND per token (`test.py:141-161`)."""
         import numpy as np
 
-        buf = np.full((1, self.buf_len), eos_id, dtype=np.int32)
-        buf[0, : len(prompt_ids)] = prompt_ids
-        plen = len(prompt_ids)
+        b = len(prompts)
+        for p in prompts:
+            assert len(p) < self.buf_len, (
+                f"prompt length {len(p)} must leave room in buf_len "
+                f"{self.buf_len}")
+        buf = np.full((b, self.buf_len), eos_id, dtype=np.int32)
+        for i, p in enumerate(prompts):
+            buf[i, : len(p)] = p
+        plens = np.asarray([len(p) for p in prompts], np.int32)
         buf, flen = self.generate(params, jnp.asarray(buf),
-                                  jnp.asarray(plen, jnp.int32),
+                                  jnp.asarray(plens),
                                   jnp.asarray(eos_id, jnp.int32),
                                   jnp.asarray(max_total_len, jnp.int32))
-        return np.asarray(buf)[0, plen : int(flen[0])].tolist()
+        buf, flen = np.asarray(buf), np.asarray(flen)
+        return [buf[i, len(prompts[i]) : int(flen[i])].tolist()
+                for i in range(b)]

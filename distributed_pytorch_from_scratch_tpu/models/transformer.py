@@ -286,9 +286,17 @@ class Transformer:
 
         layer_fn = self._layer_body
         if self.remat == "dots":
+            # checkpoint_dots saves matmul outputs; additionally pin the
+            # flash kernel's o/lse residuals (tagged via checkpoint_name in
+            # ops/pallas/flash_attention.py) so the backward pass never
+            # re-runs the forward attention kernel. On the XLA attention
+            # path the tags don't exist and the policy degrades gracefully.
+            policy = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.checkpoint_dots,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse"))
             layer_fn = jax.checkpoint(
-                layer_fn, static_argnums=(5,),
-                policy=jax.checkpoint_policies.checkpoint_dots)
+                layer_fn, static_argnums=(5,), policy=policy)
         elif self.remat:
             layer_fn = jax.checkpoint(layer_fn, static_argnums=(5,))
 

@@ -26,6 +26,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -36,9 +37,12 @@ MASK = -1e30  # hard mask; equivalent to the XLA path's -10000 (see module doc)
 # previous 512x1024 default (2.45ms vs 4.93ms fwd; 5.77ms vs 10.58ms
 # fwd+bwd per layer) — fewer grid steps amortize the VMEM pipeline better
 # at these small head dims. Blocks clamp to the padded sequence length, so
-# shorter sequences are unaffected.
+# shorter sequences are unaffected. The backward kernels are swept
+# separately (they keep larger per-block VMEM working sets).
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+DEFAULT_BWD_BLOCK_Q = 1024
+DEFAULT_BWD_BLOCK_K = 1024
 
 
 def _round_up(x: int, m: int) -> int:
@@ -303,29 +307,40 @@ def _bwd_call(q, k, v, o, lse, do, *, t_real: int, block_q: int, block_k: int):
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+                    block_k: int = DEFAULT_BLOCK_K,
+                    bwd_block_q: int = None,
+                    bwd_block_k: int = None) -> jax.Array:
     """Causal flash attention. q, k, v: (b, heads, t, head_dim).
 
     Drop-in replacement for `causal_attention_xla`
     (`/root/reference/models/model.py:73-77` semantics). Sequence length is
     padded to the block size internally; padded keys are masked, padded
-    query rows are sliced off.
+    query rows are sliced off. `bwd_block_*` tune the dq/dkv kernels
+    independently of the forward (default: the swept DEFAULT_BWD_* values).
     """
     b, h, t, d = q.shape
-    if (block_q % 128 or block_k % 128
-            or block_q & (block_q - 1) or block_k & (block_k - 1)):
-        raise ValueError(
-            f"block sizes must be power-of-two multiples of 128, got "
-            f"{block_q}x{block_k}")
+    if bwd_block_q is None:
+        bwd_block_q = DEFAULT_BWD_BLOCK_Q
+    if bwd_block_k is None:
+        bwd_block_k = DEFAULT_BWD_BLOCK_K
+    for name, blk in (("block_q", block_q), ("block_k", block_k),
+                      ("bwd_block_q", bwd_block_q),
+                      ("bwd_block_k", bwd_block_k)):
+        if blk % 128 or blk & (blk - 1):
+            raise ValueError(
+                f"{name} must be a power-of-two multiple of 128, got {blk}")
     # Clamp blocks to the next power of two >= t so that max(bq, bk) is a
     # common multiple of both and t_pad divides evenly into full q AND k
     # blocks (a non-power-of-two clamp once left q rows >= block_q
     # unwritten). Padded blocks are skipped by the kernels' block_live
-    # guards, so over-padding costs only grid overhead.
+    # guards, so over-padding costs only grid overhead. All four block
+    # sizes share one t_pad, so the bwd blocks participate in the clamp.
     pow2 = max(128, 1 << (t - 1).bit_length())
     bq = min(block_q, pow2)
     bk = min(block_k, pow2)
-    t_pad = _round_up(t, max(bq, bk))
+    bbq = min(bwd_block_q, pow2)
+    bbk = min(bwd_block_k, pow2)
+    t_pad = _round_up(t, max(bq, bk, bbq, bbk))
 
     def prep(x):
         x = x.reshape(b * h, t, d)
@@ -333,26 +348,35 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
         return x
 
-    o = _flash_with_t(prep(q), prep(k), prep(v), t, bq, bk)
+    o = _flash_with_t(prep(q), prep(k), prep(v), t, bq, bk, bbq, bbk)
     return o[:, :t, :].reshape(b, h, t, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_with_t(q, k, v, t_real: int, block_q: int, block_k: int):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_with_t(q, k, v, t_real: int, block_q: int, block_k: int,
+                  bwd_block_q: int, bwd_block_k: int):
     o, _ = _fwd_call(q, k, v, t_real=t_real, block_q=block_q, block_k=block_k)
     return o
 
 
-def _flash_with_t_fwd(q, k, v, t_real, block_q, block_k):
+def _flash_with_t_fwd(q, k, v, t_real, block_q, block_k,
+                      bwd_block_q, bwd_block_k):
     o, lse = _fwd_call(q, k, v, t_real=t_real,
                        block_q=block_q, block_k=block_k)
+    # Name the kernel outputs so remat policies can pin them: under
+    # `Transformer(remat="dots")` the checkpoint_dots policy saves only
+    # dot_general outputs, and without these tags the backward pass would
+    # re-run the forward flash kernel just to rebuild o/lse.
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
-def _flash_with_t_bwd(t_real, block_q, block_k, res, do):
+def _flash_with_t_bwd(t_real, block_q, block_k, bwd_block_q, bwd_block_k,
+                      res, do):
     q, k, v, o, lse = res
     return _bwd_call(q, k, v, o, lse, do, t_real=t_real,
-                     block_q=block_q, block_k=block_k)
+                     block_q=bwd_block_q, block_k=bwd_block_k)
 
 
 _flash_with_t.defvjp(_flash_with_t_fwd, _flash_with_t_bwd)

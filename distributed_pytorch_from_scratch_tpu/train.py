@@ -27,8 +27,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from .config import (IGNORE_INDEX, MeshConfig, ModelConfig, OptimizerConfig,
-                     TrainConfig)
+from .config import (IGNORE_INDEX, MODEL_PRESETS, REMAT_CHOICES, MeshConfig,
+                     ModelConfig, OptimizerConfig, TrainConfig, model_preset)
 from .data.dataset import get_dataloader
 from .models.transformer import Transformer
 from .runtime.mesh import make_mesh
@@ -78,11 +78,20 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="resume from the latest checkpoint in --save_dir")
 
     g = p.add_argument_group("model")
-    g.add_argument("--attn_dim", type=int, default=512)
-    g.add_argument("--ffn_dim", type=int, default=2048)
-    g.add_argument("--num_heads", type=int, default=8)
-    g.add_argument("--num_layers", type=int, default=12)
-    g.add_argument("--maxlen", type=int, default=1000)
+    g.add_argument("--model", choices=sorted(MODEL_PRESETS), default=None,
+                   help="named shape preset (BASELINE configs: '45m' is the "
+                        "reference shape, 'gpt2-124m' is config 3); explicit "
+                        "dim flags below override preset fields")
+    g.add_argument("--attn_dim", type=int, default=None)
+    g.add_argument("--ffn_dim", type=int, default=None)
+    g.add_argument("--num_heads", type=int, default=None)
+    g.add_argument("--num_layers", type=int, default=None)
+    g.add_argument("--maxlen", type=int, default=None)
+    g.add_argument("--remat", choices=sorted(REMAT_CHOICES),
+                   default="true",
+                   help="per-layer rematerialisation: 'true' = lowest "
+                        "memory, 'dots' = fastest that still bounds "
+                        "residuals (see models/transformer.py)")
 
     g = p.add_argument_group("data")
     g.add_argument("--data_path", "-d", type=str, required=True)
@@ -110,8 +119,14 @@ def train(args: argparse.Namespace) -> dict:
             f"devices; only {jax.device_count()} visible "
             f"({jax.devices()[0].platform}). For CPU testing set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count=N")
-    if args.maxlen % args.cp_size != 0:
-        raise SystemExit(f"--maxlen {args.maxlen} must be divisible by "
+    # model shape: preset fields, overridden by any explicit dim flag
+    # (reference shape = the '45m' preset = /root/reference/constants.py:9-17)
+    preset = model_preset(args.model) if args.model else ModelConfig()
+    pick = lambda flag, dflt: dflt if flag is None else flag
+    maxlen = pick(args.maxlen, preset.maxlen)
+
+    if maxlen % args.cp_size != 0:
+        raise SystemExit(f"--maxlen {maxlen} must be divisible by "
                          f"--cp_size {args.cp_size} (sequence is sharded "
                          f"over the 'cp' mesh axis)")
     if args.batch_size % args.dp_size != 0:
@@ -121,16 +136,19 @@ def train(args: argparse.Namespace) -> dict:
 
     dataloader = get_dataloader(args.data_path, args.batch_size,
                                 IGNORE_INDEX, split="train",
-                                maxlen=args.maxlen, shuffle=True,
+                                maxlen=maxlen, shuffle=True,
                                 seed=args.random_seed)
     vocab_size = dataloader.dataset.vocab_size
-    cfg = ModelConfig(attn_dim=args.attn_dim, ffn_dim=args.ffn_dim,
-                      num_heads=args.num_heads, num_layers=args.num_layers,
-                      vocab_size=vocab_size, maxlen=args.maxlen,
+    cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
+                      ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
+                      num_heads=pick(args.num_heads, preset.num_heads),
+                      num_layers=pick(args.num_layers, preset.num_layers),
+                      vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
     model = Transformer(cfg, tp_size=args.tp_size,
                     cp_size=args.cp_size, cp_impl=args.cp_impl,
-                    sequence_parallel=args.sequence_parallel)
+                    sequence_parallel=args.sequence_parallel,
+                    remat=REMAT_CHOICES[args.remat])
     print(f"model: {cfg.num_params()/1e6:.2f}M params, vocab={vocab_size}, "
           f"mesh=dp{args.dp_size} x cp{args.cp_size} x tp{args.tp_size}, "
           f"compute={cfg.compute_dtype}")
@@ -166,7 +184,7 @@ def train(args: argparse.Namespace) -> dict:
     profiler = ProfilerTrace(os.path.join(args.save_dir, "logs"),
                              start_step=start_step + 3,
                              num_steps=args.profile_steps)
-    flops_step = model_flops_per_step(cfg, args.batch_size, args.maxlen)
+    flops_step = model_flops_per_step(cfg, args.batch_size, maxlen)
     peak_flops = chip_peak_flops() * mesh_cfg.world_size
 
     steps_per_epoch = len(dataloader)

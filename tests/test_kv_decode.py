@@ -78,6 +78,40 @@ def test_kv_rejects_cp_model():
         GreedyDecoder(model, mesh, BUF)
 
 
+@pytest.mark.parametrize("tp", [1, 4])
+def test_batched_mixed_length_prompts(tp):
+    """decode_batch over prompts of DIFFERENT lengths (the evaluate.py
+    production path) must reproduce each prompt's single-row decode exactly —
+    the teacher-forced catch-up must not perturb any row."""
+    mesh = make_mesh(MeshConfig(dp=1, tp=tp))
+    model = Transformer(CFG, tp_size=tp)
+    params = jax.device_put(model.init(jax.random.key(11)),
+                            model.shardings(mesh))
+    dec = GreedyDecoder(model, mesh, BUF)
+    prompts = [
+        [0, 5, 17, 33, 60],
+        [0, 95],
+        [0, 2, 4, 6, 8, 10, 12, 14],
+        [0, 7],
+    ]
+    refs = [dec.decode(params, p, EOS, max_total_len=24) for p in prompts]
+    got = dec.decode_batch(params, prompts, EOS, max_total_len=24)
+    assert got == refs
+
+
+def test_decode_buffer_longer_than_maxlen():
+    """ADVICE r1: buf_len > cfg.maxlen used to clip RoPE positions to the
+    last table row; tables are now sized to the buffer."""
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = Transformer(CFG, tp_size=2)
+    params = jax.device_put(model.init(jax.random.key(2)),
+                            model.shardings(mesh))
+    big = CFG.maxlen + 16
+    dec = GreedyDecoder(model, mesh, big)
+    out = dec.decode(params, [0, 5, 9], EOS, max_total_len=big)
+    assert len(out) + 3 <= big
+
+
 def test_batched_generate_per_row_lengths():
     """Batch of 2 prompts through one generate call: each row's reported
     length must match its own single-prompt decode (early-EOS rows must not

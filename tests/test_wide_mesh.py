@@ -1,0 +1,22 @@
+"""16-virtual-device mesh sweep (VERDICT r1 #9).
+
+Runs tests/_wide_mesh_main.py in a subprocess with 16 forced CPU devices:
+transformer-vs-oracle equivalence (incl. the 3-D dp2xcp2xtp4 mesh and a
+non-divisible vocab) and ZeRO-1-vs-plain-Adam parity at dp4xtp4 / dp8xtp2 —
+shapes an 8-device mesh cannot express.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_wide_mesh_16_devices():
+    script = os.path.join(os.path.dirname(__file__), "_wide_mesh_main.py")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+    r = subprocess.run([sys.executable, script], env=env,
+                       capture_output=True, text=True, timeout=850,
+                       cwd=os.path.dirname(os.path.dirname(script)))
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "ALL OK" in r.stdout
