@@ -55,8 +55,14 @@ def get_eval_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__)
     g = p.add_argument_group("distributed")
     g.add_argument("--tp_size", type=int, default=1)
-    # NOTE: evaluation is TP-only (mesh dp=1), like the reference's test.py —
-    # batch sizes here (default 1) don't divide a dp axis usefully.
+    g.add_argument("--dp_size", type=int, default=1,
+                   help="shard validation batches over a 'dp' mesh axis "
+                        "(ragged final batches are padded with IGNORE_INDEX "
+                        "rows, which the masked CE mean drops exactly)")
+    g.add_argument("--cp_size", type=int, default=1,
+                   help="context-parallel axis for the validation forward "
+                        "(ring attention over sequence chunks); decoding "
+                        "always runs the cp=1 path on the same params")
 
     g = p.add_argument_group("data")
     g.add_argument("--data_path", "-d", required=True)
@@ -87,9 +93,34 @@ def get_eval_args(argv=None) -> argparse.Namespace:
     return p.parse_args(argv)
 
 
-def calc_val_loss(loss_fn, params, dataloader) -> float:
+def _pad_batch(batch, rows: int):
+    """Pad a ragged final batch (drop_last=False) up to `rows` rows so its
+    leading dim keeps dividing the dp mesh axis. Padding rows carry
+    IGNORE_INDEX targets, so the masked CE mean is unchanged exactly."""
+    have = batch["input_ids"].shape[0]
+    if have == rows:
+        return batch
+    pad = rows - have
+    return {
+        "input_ids": np.concatenate(
+            [batch["input_ids"],
+             np.zeros((pad, batch["input_ids"].shape[1]), np.int32)]),
+        "target_ids": np.concatenate(
+            [batch["target_ids"],
+             np.full((pad, batch["target_ids"].shape[1]), IGNORE_INDEX,
+                     np.int32)]),
+        "position_ids": np.concatenate(
+            [batch["position_ids"],
+             np.tile(batch["position_ids"][:1], (pad, 1))]),
+    }
+
+
+def calc_val_loss(loss_fn, params, dataloader, batch_rows: int) -> float:
+    """Per-batch-mean average, over real (unpadded) batches — fixing the
+    reference's sum-of-means / len(dataset) (`test.py:80`)."""
     total, batches = 0.0, 0
     for batch in dataloader.epoch(0):
+        batch = _pad_batch(batch, batch_rows)
         loss = loss_fn(params,
                        jnp.asarray(batch["input_ids"]),
                        jnp.asarray(batch["target_ids"]),
@@ -101,8 +132,20 @@ def calc_val_loss(loss_fn, params, dataloader) -> float:
 
 def make_greedy_decoder(model: Transformer, mesh, buf_len: int):
     """One fixed-shape jitted step: (params, buffer(1,buf_len), cur_len) ->
-    argmax token id at position cur_len-1."""
-    fwd = model.make_forward(mesh)
+    argmax token id at position cur_len-1.
+
+    The decode buffer is REPLICATED over the dp/cp mesh axes (in_specs
+    P(None, None)), like models/decode.py: `model.make_forward`'s
+    P('dp','cp') batch sharding would split the single row over dp and the
+    sequence over cp — and `model` here is the cp=1 twin, whose dense
+    attention on a cp-sharded chunk would silently drop cross-chunk
+    attention."""
+    from jax.sharding import PartitionSpec as P
+
+    fwd = jax.jit(jax.shard_map(
+        model.forward_shard, mesh=mesh,
+        in_specs=(model.specs(), P(None, None), P(None, None)),
+        out_specs=P(None, None, "tp")))
 
     def step(params, buf, cur_len):
         logits = fwd(params, buf, jnp.tile(jnp.arange(buf_len)[None, :], (1, 1)))
@@ -179,7 +222,14 @@ def evaluate(args: argparse.Namespace) -> dict:
     pick = lambda flag, dflt: dflt if flag is None else flag
     maxlen = pick(args.maxlen, preset.maxlen)
 
-    mesh = make_mesh(MeshConfig(dp=1, tp=args.tp_size))
+    if args.batch_size % args.dp_size != 0:
+        raise SystemExit(f"--batch_size {args.batch_size} must be divisible "
+                         f"by --dp_size {args.dp_size}")
+    if maxlen % args.cp_size != 0:
+        raise SystemExit(f"--maxlen {maxlen} must be divisible by "
+                         f"--cp_size {args.cp_size}")
+    mesh = make_mesh(MeshConfig(dp=args.dp_size, tp=args.tp_size,
+                                cp=args.cp_size))
     dataloader = get_dataloader(args.data_path, args.batch_size, IGNORE_INDEX,
                                 split="validation", maxlen=maxlen,
                                 shuffle=False, drop_last=False)
@@ -190,9 +240,12 @@ def evaluate(args: argparse.Namespace) -> dict:
                       num_layers=pick(args.num_layers, preset.num_layers),
                       vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
+    # val loss runs the full 3-D mesh; decoding runs the cp=1 path on the
+    # same params (models/decode.py), with its batch replicated over dp/cp.
+    model_val = Transformer(cfg, tp_size=args.tp_size, cp_size=args.cp_size)
     model = Transformer(cfg, tp_size=args.tp_size)
     template = model.init(jax.random.key(args.random_seed))
-    loss_fn = build_eval_loss(model, mesh)
+    loss_fn = build_eval_loss(model_val, mesh)
 
     ckpts = list_checkpoints(args.ckpt_dir, rank=0)
     if not ckpts:
@@ -209,7 +262,7 @@ def evaluate(args: argparse.Namespace) -> dict:
             params, _, _ = load_checkpoint(args.ckpt_dir, it, template,
                                            model.specs())
             params = jax.device_put(params, model.shardings(mesh))
-            avg = calc_val_loss(loss_fn, params, dataloader)
+            avg = calc_val_loss(loss_fn, params, dataloader, args.batch_size)
             print(f"iter {it}: val loss {avg:.4f}")
             f.write(f"{path} -> {avg:.4f}\n")
             writer.scalar("val/loss", avg, it)

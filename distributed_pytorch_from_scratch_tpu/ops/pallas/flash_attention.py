@@ -84,10 +84,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(block_live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)                     # (bq, d)
-        k = k_ref[0]                                         # (bk, d)
+        # Dot in the INPUT dtype with f32 accumulation: for bf16 inputs the
+        # result is identical to upcasting first (bf16->f32 is exact, the MXU
+        # accumulates f32 either way) but runs in one MXU pass instead of the
+        # multi-pass f32 decomposition.
         s = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale       # (bq, bk)
 
         row = qi * block_q + jax.lax.broadcasted_iota(
@@ -176,9 +178,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(block_live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+        # Input-dtype dots + f32 accumulation throughout (see _fwd_kernel);
+        # ds is cast back to the input dtype before its dot — the standard
+        # flash-attention-2 bf16 backward. For f32 inputs every cast is a
+        # no-op, keeping the tight-tolerance CPU tests exact.
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         row = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
@@ -187,11 +191,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jnp.where((col > row) | (col >= t_real), MASK, s)
         p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
         dp = jax.lax.dot_general(
-            do_ref[0].astype(jnp.float32), v_ref[0].astype(jnp.float32),
+            do_ref[0], v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0]) * scale
+        ds = (p * (dp - delta_ref[0]) * scale).astype(q_ref.dtype)
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds, k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == num_kb - 1)
@@ -215,9 +219,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(block_live)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        st = jax.lax.dot_general(k, q, (((1,), (1,)), ((), ())),
+        # Input-dtype dots + f32 accumulation; pt/dst cast back to the input
+        # dtype before their dots (see _dq_kernel).
+        st = jax.lax.dot_general(k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
         col = ki * block_k + jax.lax.broadcasted_iota(    # key index
             jnp.int32, (block_k, block_q), 0)
@@ -226,16 +230,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         st = jnp.where((col > row) | (col >= t_real) | (row >= t_real),
                        MASK, st)
         pt = jnp.exp(st - jnp.transpose(lse_ref[0]))         # (bk, bq)
-        do = do_ref[0].astype(jnp.float32)
         dv_acc[:] += jax.lax.dot_general(
-            pt, do, (((1,), (0,)), ((), ())),
+            pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dpt = jax.lax.dot_general(
-            v_ref[0].astype(jnp.float32), do, (((1,), (1,)), ((), ())),
+            v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bk, bq)
-        dst = pt * (dpt - jnp.transpose(delta_ref[0])) * scale
+        dst = (pt * (dpt - jnp.transpose(delta_ref[0])) * scale
+               ).astype(q_ref.dtype)
         dk_acc[:] += jax.lax.dot_general(
-            dst, q, (((1,), (0,)), ((), ())),
+            dst, q_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == num_qb - 1)

@@ -21,6 +21,8 @@ from __future__ import annotations
 import argparse
 import math
 import os
+import signal
+import threading
 import time
 from typing import Optional
 
@@ -106,6 +108,39 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "non-finite value (the functional analogue of a "
                         "sanitizer — SURVEY §5.2)")
     return p.parse_args(argv)
+
+
+class _ShutdownFlag:
+    """Preemption-safe shutdown: SIGTERM/SIGINT set a flag the train loop
+    polls each step, so it saves a final checkpoint and exits cleanly.
+
+    This is the failure-recovery story the reference lacks entirely
+    (`mp.spawn(join=True)` — any signal just kills the job, SURVEY §5.3);
+    on preemptible TPU VMs the eviction notice arrives as SIGTERM, making
+    this the idiomatic TPU equivalent of elastic-training hooks. Handlers
+    are only installed on the main thread (signal.signal raises elsewhere)
+    and restored on exit so embedding callers (tests) are unaffected.
+    """
+
+    def __init__(self):
+        self.requested = False
+        self._installed = []
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev = signal.signal(sig, self._handle)
+                self._installed.append((sig, prev))
+
+    def _handle(self, signum, frame):
+        self.requested = True
+        # Graceful shutdown can take a full train step + checkpoint write;
+        # restore the previous handlers immediately so a SECOND signal
+        # force-quits instead of being swallowed.
+        self.restore()
+
+    def restore(self):
+        while self._installed:
+            sig, prev = self._installed.pop()
+            signal.signal(sig, prev)
 
 
 def train(args: argparse.Namespace) -> dict:
@@ -203,52 +238,83 @@ def train(args: argparse.Namespace) -> dict:
     accum_loss, n = jnp.zeros((), jnp.float32), start_step
     t_start, tokens_since, steps_since = time.time(), 0, 0
     done = False
-    for epoch in range(start_epoch, max_epoch):
-        for i, batch in enumerate(dataloader.epoch(epoch)):
-            if epoch == start_epoch and i < skip_batches:
-                continue
-            if args.profile_steps:
-                profiler.maybe_start(n)
-            params, opt_state, loss = step_fn(
-                params, opt_state,
-                jnp.asarray(batch["input_ids"]),
-                jnp.asarray(batch["target_ids"]),
-                jnp.asarray(batch["position_ids"]))
-            n += 1
-            if args.profile_steps:
-                profiler.maybe_stop(n, sync=loss)
-            accum_loss = accum_loss + loss
-            tokens_since += batch["input_ids"].size
-            steps_since += 1
-            if n % args.log_interval == 0:
-                lr, _ = onecycle_lr(ocfg, jnp.asarray(n - 1))
-                avg = float(accum_loss) / (n - start_step)
-                dt = time.time() - t_start
-                tps = tokens_since / max(dt, 1e-9)
-                mfu = (flops_step * steps_since) / max(dt, 1e-9) / peak_flops
-                print(f"step {n}/{args.max_steps} -> avg loss {avg:.4f}, "
-                      f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s, "
-                      f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f} GiB")
-                writer.scalar("train/ce_loss", avg, n)
-                writer.scalar("train/lr", float(lr), n)
-                writer.scalar("train/tokens_per_sec", tps, n)
-                writer.scalar("train/mfu", mfu, n)
-                writer.scalar("device_memory_gib", device_memory_gib(), n)
-                t_start, tokens_since, steps_since = time.time(), 0, 0
-            if n % args.save_interval == 0:
-                avg = float(accum_loss) / (n - start_step)
-                paths = save_checkpoint(
-                    args.save_dir, n, avg, params, model.specs(),
-                    args.tp_size, opt_state,
-                    reserve_last_n=args.reserve_last_n_ckpts)
-                print(f"saved checkpoint iter {n}: {paths[0]}" +
-                      (f" (+{len(paths)-1} shards)" if len(paths) > 1 else ""))
-            if n >= args.max_steps:
-                done = True
+    shutdown = _ShutdownFlag()
+    last_saved = start_step
+    pending_save = None  # at most one async checkpoint write in flight
+
+    def join_save():
+        nonlocal pending_save
+        if pending_save is not None:
+            paths = pending_save.join()
+            print(f"saved checkpoint iter {pending_save.step}: {paths[0]}" +
+                  (f" (+{len(paths)-1} shards)" if len(paths) > 1 else ""))
+            pending_save = None
+
+    def schedule_save(step):
+        nonlocal pending_save, last_saved
+        avg = float(accum_loss) / (step - start_step)
+        join_save()  # bound in-flight async writes to one
+        pending_save = save_checkpoint(
+            args.save_dir, step, avg, params, model.specs(),
+            args.tp_size, opt_state,
+            reserve_last_n=args.reserve_last_n_ckpts,
+            async_write=True)
+        last_saved = step
+
+    try:
+        for epoch in range(start_epoch, max_epoch):
+            for i, batch in enumerate(dataloader.epoch(epoch)):
+                if epoch == start_epoch and i < skip_batches:
+                    continue
+                if args.profile_steps:
+                    profiler.maybe_start(n)
+                params, opt_state, loss = step_fn(
+                    params, opt_state,
+                    jnp.asarray(batch["input_ids"]),
+                    jnp.asarray(batch["target_ids"]),
+                    jnp.asarray(batch["position_ids"]))
+                n += 1
+                if args.profile_steps:
+                    profiler.maybe_stop(n, sync=loss)
+                accum_loss = accum_loss + loss
+                tokens_since += batch["input_ids"].size
+                steps_since += 1
+                if n % args.log_interval == 0:
+                    lr, _ = onecycle_lr(ocfg, jnp.asarray(n - 1))
+                    avg = float(accum_loss) / (n - start_step)
+                    dt = time.time() - t_start
+                    tps = tokens_since / max(dt, 1e-9)
+                    mfu = (flops_step * steps_since) / max(dt, 1e-9) / peak_flops
+                    print(f"step {n}/{args.max_steps} -> avg loss {avg:.4f}, "
+                          f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s, "
+                          f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f} GiB")
+                    writer.scalar("train/ce_loss", avg, n)
+                    writer.scalar("train/lr", float(lr), n)
+                    writer.scalar("train/tokens_per_sec", tps, n)
+                    writer.scalar("train/mfu", mfu, n)
+                    writer.scalar("device_memory_gib", device_memory_gib(), n)
+                    t_start, tokens_since, steps_since = time.time(), 0, 0
+                if n % args.save_interval == 0:
+                    schedule_save(n)
+                if shutdown.requested:
+                    if n > last_saved:
+                        schedule_save(n)
+                    print(f"shutdown requested: checkpointed at step {n}; "
+                          f"restart with --resume to continue")
+                    done = True
+                    break
+                if n >= args.max_steps:
+                    done = True
+                    break
+            print(f"epoch {epoch + 1}/{max_epoch} finished")
+            if done:
                 break
-        print(f"epoch {epoch + 1}/{max_epoch} finished")
-        if done:
-            break
+    finally:
+        # On ANY exit (including a raising step): let the in-flight async
+        # write finish so no truncated npz is left behind, and put the
+        # previous signal handlers back so embedding callers keep Ctrl-C.
+        shutdown.restore()
+        join_save()
 
     final_avg = float(accum_loss) / max(n - start_step, 1)
     profiler.close(sync=accum_loss)

@@ -64,6 +64,37 @@ def test_shards_are_actual_slices(tmp_path):
     assert shard0["param/norm/scale"].shape == (CFG.attn_dim,)
 
 
+def test_async_save_matches_sync_and_survives_donation(tmp_path):
+    """async_write=True must produce byte-identical files to the sync path,
+    and the on-device snapshot must keep the write valid even when the
+    caller's buffers are donated away immediately after scheduling (the
+    train loop's donate_argnums pattern, training/train_step.py)."""
+    model = Transformer(CFG, tp_size=2)
+    params = model.init(jax.random.key(4))
+    opt = init_adam_state(params)
+
+    sync_dir, async_dir = str(tmp_path / "sync"), str(tmp_path / "async")
+    save_checkpoint(sync_dir, 7, 1.5, params, model.specs(), tp_size=2,
+                    opt_state=opt)
+    handle = save_checkpoint(async_dir, 7, 1.5, params, model.specs(),
+                             tp_size=2, opt_state=opt, async_write=True)
+    # donate the original buffers away while the write may still be running
+    bump = jax.jit(lambda t: jax.tree.map(lambda x: x + 1.0, t),
+                   donate_argnums=(0,))
+    params = bump(params)
+
+    paths = handle.join()
+    assert handle.step == 7
+    assert [os.path.basename(p) for p in paths] == [
+        "tprank-0_iter-7_loss-1.5000.npz", "tprank-1_iter-7_loss-1.5000.npz"]
+    for rank in range(2):
+        a = np.load(os.path.join(async_dir, f"tprank-{rank}_iter-7_loss-1.5000.npz"))
+        s = np.load(os.path.join(sync_dir, f"tprank-{rank}_iter-7_loss-1.5000.npz"))
+        assert sorted(a.files) == sorted(s.files)
+        for key in a.files:
+            np.testing.assert_array_equal(a[key], s[key])
+
+
 def test_retention_pruning(tmp_path):
     model = Transformer(CFG, tp_size=2)
     params = model.init(jax.random.key(2))

