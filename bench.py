@@ -12,6 +12,8 @@ Flags cover the other BASELINE.md configs:
     --remat {true,dots,false}      rematerialisation policy
     --batch N --seqlen N           override the experiment shape
     --dp N --tp N                  mesh axes (world = dp*tp must match chips)
+    --steps_per_dispatch N         optimizer steps per device dispatch
+                                   (train.py's scanned megabatch mode)
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the
 driver-assigned north star is used — MFU >= 30% on TPU. vs_baseline is
@@ -37,7 +39,7 @@ from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
 from distributed_pytorch_from_scratch_tpu.training.metrics import (
     allreduce_p50_us, chip_peak_flops, device_memory_gib, model_flops_per_step)
 from distributed_pytorch_from_scratch_tpu.training.train_step import (
-    build_train_step)
+    build_train_step, build_train_step_multi)
 
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
@@ -55,6 +57,12 @@ def parse_args(argv=None):
     p.add_argument("--tp", type=int, default=0,
                    help="0 = all remaining local chips")
     p.add_argument("--iters", type=int, default=8)
+    # The product training mode this measures: train.py --steps_per_dispatch
+    # runs N optimizer steps per device dispatch (lax.scan over a stacked
+    # megabatch, training/train_step.py:build_train_step_multi), amortising
+    # the host->device round-trip N-fold. 1 = the reference-style
+    # one-dispatch-per-step loop.
+    p.add_argument("--steps_per_dispatch", type=int, default=8)
     return p.parse_args(argv)
 
 
@@ -69,7 +77,11 @@ def main(argv=None):
                             model.shardings(mesh))
     opt_state = init_adam_state(params)
     ocfg = OptimizerConfig()
-    step_fn = build_train_step(model, mesh, ocfg)
+    spd = max(1, args.steps_per_dispatch)
+    if spd > 1:
+        step_fn = build_train_step_multi(model, mesh, ocfg)
+    else:
+        step_fn = build_train_step(model, mesh, ocfg)
 
     B = args.batch or (8 if args.model == "gpt2-124m" else 32)
     T = args.seqlen or cfg.maxlen
@@ -77,25 +89,36 @@ def main(argv=None):
     ids = jax.random.randint(key, (B, T), 0, cfg.vocab_size)
     tgt = jnp.roll(ids, -1, axis=1)
     pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (B, 1))
+    if spd > 1:
+        # same batch content each scanned step: throughput-identical to a
+        # real stream (shapes are what matter), one H2D instead of N
+        ids, tgt, pos = (jnp.tile(x[None], (spd, 1, 1)) for x in (ids, tgt, pos))
 
-    # NOTE: timing must sync via a device->host copy (float(loss)):
+    def run_once():
+        nonlocal params, opt_state
+        params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
+        return loss
+
+    # NOTE: timing must sync via a device->host copy (float(...)):
     # block_until_ready returns early for chained donated executions on the
-    # axon platform. The first two steps are excluded — the second triggers a
-    # one-time recompile when donated output layouts replace device_put's.
+    # axon platform. The first two dispatches are excluded — the second
+    # triggers a one-time recompile when donated output layouts replace
+    # device_put's.
     t0 = time.time()
-    params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
-    float(loss)
+    loss = run_once()
+    float(jnp.sum(loss))
     compile_s = time.time() - t0
 
     warm, iters = 2, args.iters
     for _ in range(warm):
-        params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
-        float(loss)
+        loss = run_once()
+        float(jnp.sum(loss))
     t0 = time.time()
     for _ in range(iters):
-        params, opt_state, loss = step_fn(params, opt_state, ids, tgt, pos)
+        loss = run_once()
+    loss = jnp.mean(loss)
     float(loss)
-    step_s = (time.time() - t0) / iters
+    step_s = (time.time() - t0) / (iters * spd)
 
     world = args.dp * tp
     tokens_per_sec_per_chip = B * T / step_s / world
@@ -122,7 +145,8 @@ def main(argv=None):
 
     print(json.dumps({
         "metric": (f"tokens/sec/chip ({args.model} GPT, bf16, b{B}xt{T}, "
-                   f"dp={args.dp}, tp={tp}, remat={args.remat})"),
+                   f"dp={args.dp}, tp={tp}, remat={args.remat}, "
+                   f"steps_per_dispatch={spd})"),
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu / 0.30, 4),

@@ -28,6 +28,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .config import (IGNORE_INDEX, MODEL_PRESETS, REMAT_CHOICES, MeshConfig,
                      ModelConfig, OptimizerConfig, TrainConfig, model_preset)
@@ -40,7 +41,7 @@ from .training.metrics import (MetricsWriter, ProfilerTrace,
                                chip_peak_flops, device_memory_gib,
                                model_flops_per_step)
 from .training.optim import init_adam_state, onecycle_lr
-from .training.train_step import build_train_step
+from .training.train_step import build_train_step, build_train_step_multi
 from .training.zero import zero1_moment_shardings
 
 
@@ -78,6 +79,11 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    default="vocab_parallel")
     g.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --save_dir")
+    g.add_argument("--steps_per_dispatch", type=int, default=1,
+                   help="run N optimizer steps per device dispatch "
+                        "(lax.scan over a stacked megabatch): bitwise the "
+                        "same training, N-fold fewer host round-trips; "
+                        "logs/saves land on dispatch boundaries")
 
     g = p.add_argument_group("model")
     g.add_argument("--model", choices=sorted(MODEL_PRESETS), default=None,
@@ -211,9 +217,20 @@ def train(args: argparse.Namespace) -> dict:
             step=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
             mu=moment_sh, nu=moment_sh))
 
-    step_fn = build_train_step(model, mesh, ocfg, args.loss_mode,
-                               zero1=args.zero1,
-                               moment_shardings=moment_sh if args.zero1 else None)
+    spd = max(1, args.steps_per_dispatch)
+    if spd > 1 and args.max_steps % spd != 0:
+        print(f"note: --max_steps {args.max_steps} is not a multiple of "
+              f"--steps_per_dispatch {spd}: the final "
+              f"{args.max_steps % spd}-step tail triggers a one-time XLA "
+              f"recompile (pick a divisible pair to avoid it)")
+    if spd > 1:
+        step_fn = build_train_step_multi(
+            model, mesh, ocfg, args.loss_mode, zero1=args.zero1,
+            moment_shardings=moment_sh if args.zero1 else None)
+    else:
+        step_fn = build_train_step(
+            model, mesh, ocfg, args.loss_mode, zero1=args.zero1,
+            moment_shardings=moment_sh if args.zero1 else None)
     writer = MetricsWriter(os.path.join(args.save_dir, "logs"))
     # profile a window shortly after start so compile+layout churn is over
     profiler = ProfilerTrace(os.path.join(args.save_dir, "logs"),
@@ -261,25 +278,60 @@ def train(args: argparse.Namespace) -> dict:
             async_write=True)
         last_saved = step
 
+    batch_buf = []  # batches awaiting one (possibly multi-step) dispatch
     try:
         for epoch in range(start_epoch, max_epoch):
             for i, batch in enumerate(dataloader.epoch(epoch)):
                 if epoch == start_epoch and i < skip_batches:
                     continue
+                # Shutdown poll once per BATCH (not per dispatch): buffered
+                # batches were never trained on, so dropping them loses
+                # nothing — resume re-reads them — and no signal ever waits
+                # on one more multi-step dispatch. Dispatch is async, so a
+                # signal arriving mid-execution is caught here before the
+                # next dispatch launches.
+                if shutdown.requested:
+                    batch_buf = []
+                    if n > last_saved:
+                        schedule_save(n)
+                    print(f"shutdown requested: checkpointed at step {n}; "
+                          f"restart with --resume to continue")
+                    done = True
+                    break
+                # Buffer up to `spd` batches, then run them as ONE dispatch
+                # (lax.scan inside the jitted program when spd > 1). The
+                # buffer carries across epoch boundaries — batch shapes are
+                # fixed, so nothing forces a flush there — and shrinks near
+                # max_steps so the run ends exactly on it.
+                batch_buf.append(batch)
+                want = min(spd, args.max_steps - n)
+                if len(batch_buf) < want:
+                    continue
+                prev_n = n
                 if args.profile_steps:
                     profiler.maybe_start(n)
-                params, opt_state, loss = step_fn(
-                    params, opt_state,
-                    jnp.asarray(batch["input_ids"]),
-                    jnp.asarray(batch["target_ids"]),
-                    jnp.asarray(batch["position_ids"]))
-                n += 1
+                if spd > 1:
+                    stacked = {key: jnp.asarray(np.stack(
+                        [b[key] for b in batch_buf]))
+                        for key in ("input_ids", "target_ids", "position_ids")}
+                    params, opt_state, losses = step_fn(
+                        params, opt_state, stacked["input_ids"],
+                        stacked["target_ids"], stacked["position_ids"])
+                    loss = jnp.sum(losses)
+                else:
+                    params, opt_state, loss = step_fn(
+                        params, opt_state,
+                        jnp.asarray(batch_buf[0]["input_ids"]),
+                        jnp.asarray(batch_buf[0]["target_ids"]),
+                        jnp.asarray(batch_buf[0]["position_ids"]))
+                n += len(batch_buf)
+                tokens_since += sum(b["input_ids"].size for b in batch_buf)
+                steps_since += len(batch_buf)
+                batch_buf = []
                 if args.profile_steps:
                     profiler.maybe_stop(n, sync=loss)
                 accum_loss = accum_loss + loss
-                tokens_since += batch["input_ids"].size
-                steps_since += 1
-                if n % args.log_interval == 0:
+                if n // args.log_interval > prev_n // args.log_interval:
                     lr, _ = onecycle_lr(ocfg, jnp.asarray(n - 1))
                     avg = float(accum_loss) / (n - start_step)
                     dt = time.time() - t_start
@@ -294,15 +346,8 @@ def train(args: argparse.Namespace) -> dict:
                     writer.scalar("train/mfu", mfu, n)
                     writer.scalar("device_memory_gib", device_memory_gib(), n)
                     t_start, tokens_since, steps_since = time.time(), 0, 0
-                if n % args.save_interval == 0:
+                if n // args.save_interval > prev_n // args.save_interval:
                     schedule_save(n)
-                if shutdown.requested:
-                    if n > last_saved:
-                        schedule_save(n)
-                    print(f"shutdown requested: checkpointed at step {n}; "
-                          f"restart with --resume to continue")
-                    done = True
-                    break
                 if n >= args.max_steps:
                     done = True
                     break

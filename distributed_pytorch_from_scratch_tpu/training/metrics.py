@@ -88,9 +88,15 @@ class ProfilerTrace:
         self.start_step = start_step
         self.stop_step = start_step + num_steps
         self._active = False
+        self._done = False
 
     def maybe_start(self, step: int) -> None:
-        if not self._active and self.start_step <= step < self.stop_step:
+        # ">= start" rather than "inside the window": the caller's step
+        # counter may jump by steps_per_dispatch and clear the whole window
+        # in one hop — the trace then starts at the first boundary past
+        # start_step and covers at least num_steps (`_done` stops it from
+        # restarting every later step)
+        if not self._active and not self._done and step >= self.start_step:
             os.makedirs(self.log_dir, exist_ok=True)
             jax.profiler.start_trace(self.log_dir)
             self._active = True
@@ -104,6 +110,7 @@ class ProfilerTrace:
                 jax.block_until_ready(sync)
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
             print(f"profiler trace written to {self.log_dir}")
 
     def close(self, sync=None) -> None:
