@@ -354,6 +354,14 @@ def train(args: argparse.Namespace) -> dict:
             print(f"epoch {epoch + 1}/{max_epoch} finished")
             if done:
                 break
+        # A signal that lands during the run's FINAL dispatch exits the loop
+        # via the max_steps break without passing the per-batch poll — it
+        # must still checkpoint the trained state (the pre-multi-dispatch
+        # code polled after every step and caught this window).
+        if shutdown.requested and n > last_saved:
+            schedule_save(n)
+            print(f"shutdown requested: checkpointed at step {n}; "
+                  f"restart with --resume to continue")
     finally:
         # On ANY exit (including a raising step): let the in-flight async
         # write finish so no truncated npz is left behind, and put the

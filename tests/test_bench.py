@@ -1,0 +1,33 @@
+"""The driver-facing bench contract: `bench.py` must print exactly ONE JSON
+line on stdout with the metric/value/unit/vs_baseline keys, whatever flags
+are set. Runs the real harness on the virtual CPU mesh at a tiny shape."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.parametrize("extra", [
+    ["--steps_per_dispatch", "1", "--tp", "1"],
+    ["--steps_per_dispatch", "2", "--tp", "2"],
+])
+def test_bench_emits_one_json_line(extra):
+    p = subprocess.run(
+        [sys.executable, "-c", (
+            "import os;"
+            "os.environ['XLA_FLAGS']=os.environ.get('XLA_FLAGS','')"
+            " + ' --xla_force_host_platform_device_count=8';"
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import bench;"
+            "bench.main(['--model','tiny','--batch','2','--seqlen','64',"
+            "'--iters','1'] + %r)" % (extra,))],
+        capture_output=True, text=True, timeout=500, cwd="/root/repo")
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "tokens/sec/chip"
+    assert rec["value"] > 0

@@ -63,6 +63,48 @@ def test_multi_step_matches_single(tp, dp):
     assert int(o2.step) == N
 
 
+def test_multi_step_zero1_matches_single_zero1():
+    """The scanned program under ZeRO-1 (dp-sharded Adam moments) matches
+    single-step ZeRO-1 — the out_shardings plumbing differs, the math must
+    not."""
+    from distributed_pytorch_from_scratch_tpu.training.zero import (
+        zero1_moment_shardings)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_mesh(MeshConfig(dp=4, tp=2))
+    model = Transformer(CFG, tp_size=2)
+    ocfg = OptimizerConfig(lr=1e-3, warmup_steps=2, max_steps=8)
+    sh = model.shardings(mesh)
+    moment_sh = zero1_moment_shardings(model, mesh)
+    scalar = NamedSharding(mesh, P())
+    N = 3
+    ids, tgt, pos = _batches(jax.random.key(2), N)
+
+    def fresh():
+        p = jax.device_put(model.init(jax.random.key(0)), sh)
+        o = init_adam_state(p)
+        o = jax.device_put(o, o.__class__(step=scalar, mu=moment_sh,
+                                          nu=moment_sh))
+        return p, o
+
+    p1, o1 = fresh()
+    step = build_train_step(model, mesh, ocfg, zero1=True,
+                            moment_shardings=moment_sh)
+    for s in range(N):
+        p1, o1, _ = step(p1, o1, ids[s], tgt[s], pos[s])
+
+    p2, o2 = fresh()
+    multi = build_train_step_multi(model, mesh, ocfg, zero1=True,
+                                   moment_shardings=moment_sh)
+    p2, o2, losses = multi(p2, o2, ids, tgt, pos)
+
+    assert losses.shape == (N,)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-6), p1, p2)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-6), o1.mu, o2.mu)
+
+
 def test_cli_steps_per_dispatch_matches(tmp_path):
     """train.py --steps_per_dispatch 2 reproduces the plain run: same final
     avg loss, same checkpoint steps (saves land on dispatch boundaries)."""
