@@ -69,6 +69,14 @@ class Transformer:
     # heads<->sequence and runs the dense kernel on the full sequence.
     cp_size: int = 1
     cp_impl: str = "ring"
+    # cp_layout='zigzag' feeds each cp shard an equally early+late pair of
+    # sequence sub-chunks (ops/ring_attention.zigzag_perm), balancing the
+    # causal ring's per-step work ~2x vs contiguous chunks. Pure input
+    # permutation: ring attention masks by the carried global positions, so
+    # both layouts are exact. Ring-only — Ulysses gathers the sequence in
+    # rank order and runs a position-oblivious triangular mask, which a
+    # permuted layout would silently break.
+    cp_layout: str = "contiguous"
     # Megatron-style sequence parallelism over 'tp' (absent from the
     # reference: its norms are replicated and inter-block activations are
     # full-size on every rank — SURVEY §2.4 "SP ❌"). When on, activations
@@ -114,6 +122,12 @@ class Transformer:
             raise ValueError(
                 f"ulysses needs local heads {cfg.num_heads // tp} divisible "
                 f"by cp_size {self.cp_size}; use cp_impl='ring'")
+        if self.cp_layout not in ("contiguous", "zigzag"):
+            raise ValueError(f"cp_layout must be 'contiguous' or 'zigzag', "
+                             f"got {self.cp_layout!r}")
+        if self.cp_layout == "zigzag" and self.cp_impl != "ring":
+            raise ValueError("cp_layout='zigzag' requires cp_impl='ring' "
+                             "(Ulysses assumes rank-order contiguous chunks)")
 
     # ---- sub-module definitions (static, cheap to rebuild) ----
 
@@ -375,21 +389,52 @@ class Transformer:
 
     # ---- global (jitted) entry points ----
 
+    @property
+    def _zigzag(self) -> bool:
+        return self.cp_layout == "zigzag" and self.cp_size > 1
+
     def make_forward(self, mesh: Mesh):
         """Jitted global forward: (params, input_ids, position_ids) -> full
-        logits (b, t, vocab_padded), vocab dim sharded over 'tp'."""
+        logits (b, t, vocab_padded), vocab dim sharded over 'tp'.
+
+        With cp_layout='zigzag', inputs are permuted into the zig-zag order
+        before the shard_map and the logits inverse-permuted after, so the
+        caller sees natural token order either way."""
+        from ..ops.ring_attention import zigzag_perm
+
         fwd = jax.shard_map(
             self.forward_shard, mesh=mesh,
             in_specs=(self.specs(), P("dp", "cp"), P("dp", "cp")),
             out_specs=P("dp", "cp", "tp"),
         )
-        return jax.jit(fwd)
+        if not self._zigzag:
+            return jax.jit(fwd)
+
+        def zz(params, input_ids, position_ids):
+            perm = zigzag_perm(input_ids.shape[1], self.cp_size)
+            inv = perm.argsort()
+            logits = fwd(params, input_ids[:, perm], position_ids[:, perm])
+            return logits[:, inv]
+
+        return jax.jit(zz)
 
     def make_loss(self, mesh: Mesh, mode: str = "vocab_parallel"):
+        from ..ops.ring_attention import zigzag_perm
+
         loss = functools.partial(self.loss_shard, mode=mode)
         fn = jax.shard_map(
             loss, mesh=mesh,
             in_specs=(self.specs(), P("dp", "cp"), P("dp", "cp"), P("dp", "cp")),
             out_specs=P(),
         )
-        return jax.jit(fn)
+        if not self._zigzag:
+            return jax.jit(fn)
+
+        def zz(params, input_ids, target_ids, position_ids):
+            # masked token-mean CE is permutation-invariant: permute all
+            # three together, no unpermute needed
+            perm = zigzag_perm(input_ids.shape[1], self.cp_size)
+            return fn(params, input_ids[:, perm], target_ids[:, perm],
+                      position_ids[:, perm])
+
+        return jax.jit(zz)

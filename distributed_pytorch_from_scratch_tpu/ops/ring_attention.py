@@ -37,10 +37,32 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .attention import causal_attention
 from .collectives import all_to_all, ring_permute
+
+
+def zigzag_perm(t: int, n: int) -> np.ndarray:
+    """Token permutation for the zig-zag context-parallel layout.
+
+    The sequence splits into 2n sub-chunks; cp shard r owns sub-chunks r and
+    2n-1-r, so every shard holds an equally early+late slice of the causal
+    triangle and ring work is balanced (see `ring_attention`). Returns the
+    gather indices: `x[:, zigzag_perm(t, n)]` reorders a batch so a plain
+    contiguous P('cp') sharding lands each shard its zig-zag pair. Static
+    (numpy) — shapes are compile-time constants under jit.
+    """
+    if t % (2 * n):
+        raise ValueError(f"zigzag layout needs sequence length {t} divisible "
+                         f"by 2*cp ({2 * n})")
+    c = t // (2 * n)
+    idx = []
+    for r in range(n):
+        idx.extend(range(r * c, (r + 1) * c))
+        idx.extend(range((2 * n - 1 - r) * c, (2 * n - r) * c))
+    return np.asarray(idx)
 
 _BIG_NEG = -1e30  # mask fill for f32 online softmax; exp() underflows to 0
 
@@ -76,9 +98,24 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
              `position_ids` the model already carries; the K/V copy rides the
              ring so causal masks are exact for any position layout).
     Returns (b, heads_local, t_local, head_dim), same dtype as q.
+
+    Work skipping is at HALF-chunk granularity: the local sequence splits
+    into two sub-chunks and each ring step runs up to four
+    (Q-half, KV-half) blocks, each skipped by `lax.cond` when causality
+    masks it entirely (every kv position after every q position). With the
+    default contiguous layout that skips ~half of all blocks but leaves the
+    ring imbalanced (the last shard computes every block — ADVICE r1); with
+    the zig-zag layout (`models.transformer cp_layout='zigzag'`: shard r
+    owns sub-chunks r and 2n-1-r) every shard computes the same ~half, so
+    the synchronous ring's per-step latency drops ~2x. Positions decide the
+    masks, so BOTH layouts are exact here — the layout is purely the
+    caller's input permutation.
     """
     n = lax.axis_size(axis)
     scale = 1.0 / math.sqrt(q.shape[-1])
+    t_local = q.shape[2]
+    halves = 2 if t_local % 2 == 0 else 1
+    th = t_local // halves
     qf = q.astype(jnp.float32)
 
     # derive the accumulators from qf so they inherit its varying-axes tags
@@ -88,9 +125,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     m0 = qf[..., 0] * 0.0 + _BIG_NEG
     l0 = qf[..., 0] * 0.0
 
-    def accumulate(o, m, l, k_cur, v_cur, pos_cur):
+    q_halves = [qf[:, :, i * th:(i + 1) * th] for i in range(halves)]
+    qp_halves = [q_pos[:, i * th:(i + 1) * th] for i in range(halves)]
+
+    def block_into(o, m, l, qh, qph, k_cur, v_cur, pos_cur):
         def compute(o, m, l):
-            bo, bm, bl = _block_attn(qf, k_cur, v_cur, q_pos, pos_cur, scale)
+            bo, bm, bl = _block_attn(qh, k_cur, v_cur, qph, pos_cur, scale)
             m_new = jnp.maximum(m, bm)
             # correction factors; exp(_BIG_NEG - m_new) underflows to exactly 0
             c_old = jnp.exp(m - m_new)
@@ -99,19 +139,32 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             l = l * c_old + bl * c_blk
             return o, m_new, l
 
-        # Skip blocks causality masks entirely (every kv position after every
-        # q position) — with contiguous chunks that is ~half of all
-        # (Q-chunk, KV-chunk) pairs (ADVICE r1). The ring stays synchronous,
-        # so the busiest shard still bounds per-step latency; balancing that
-        # too would need zig-zag sequence sharding (shard r owning chunks r
-        # and 2n-1-r), a data-layout contract change deliberately not made.
-        fully_masked = jnp.max(q_pos) < jnp.min(pos_cur)
+        fully_masked = jnp.max(qph) < jnp.min(pos_cur)
         return lax.cond(fully_masked, lambda o, m, l: (o, m, l), compute,
                         o, m, l)
 
+    def accumulate_all(o, m, l, k_cur, v_cur, pos_cur):
+        new_o, new_m, new_l = [], [], []
+        for i in range(halves):
+            oi = o[:, :, i * th:(i + 1) * th]
+            mi = m[:, :, i * th:(i + 1) * th]
+            li = l[:, :, i * th:(i + 1) * th]
+            for j in range(halves):
+                kj = k_cur[:, :, j * th:(j + 1) * th]
+                vj = v_cur[:, :, j * th:(j + 1) * th]
+                pj = pos_cur[:, j * th:(j + 1) * th]
+                oi, mi, li = block_into(oi, mi, li, q_halves[i],
+                                        qp_halves[i], kj, vj, pj)
+            new_o.append(oi)
+            new_m.append(mi)
+            new_l.append(li)
+        return (jnp.concatenate(new_o, axis=2),
+                jnp.concatenate(new_m, axis=2),
+                jnp.concatenate(new_l, axis=2))
+
     def step(carry, _):
         o, m, l, k_cur, v_cur, pos_cur = carry
-        o, m, l = accumulate(o, m, l, k_cur, v_cur, pos_cur)
+        o, m, l = accumulate_all(o, m, l, k_cur, v_cur, pos_cur)
         # rotate KV (+ its positions) one hop around the ring
         k_nxt = ring_permute(k_cur, axis)
         v_nxt = ring_permute(v_cur, axis)
@@ -123,7 +176,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     # inside the compiled scan body. With cp=1 this is fully collective-free.
     (o, m, l, k_l, v_l, pos_l), _ = lax.scan(
         step, (o0, m0, l0, k, v, q_pos), None, length=n - 1)
-    o, m, l = accumulate(o, m, l, k_l, v_l, pos_l)
+    o, m, l = accumulate_all(o, m, l, k_l, v_l, pos_l)
     # every query attends at least to itself => l > 0 for real tokens
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)

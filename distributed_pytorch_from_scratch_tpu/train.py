@@ -54,6 +54,11 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--cp_size", type=int, default=1,
                    help="context-parallel (sequence) axis size")
     g.add_argument("--cp_impl", choices=["ring", "ulysses"], default="ring")
+    g.add_argument("--cp_layout", choices=["contiguous", "zigzag"],
+                   default="contiguous",
+                   help="zigzag: each cp shard gets an equally early+late "
+                        "pair of sequence sub-chunks, balancing causal ring "
+                        "work ~2x (ring impl only; needs maxlen % (2*cp)==0)")
     g.add_argument("--sequence_parallel", action="store_true",
                    help="Megatron-style SP: shard inter-block activations "
                         "over the tp axis (reduce-scatter/all-gather instead "
@@ -188,6 +193,7 @@ def train(args: argparse.Namespace) -> dict:
                       compute_dtype="bfloat16" if args.bf16 else "float32")
     model = Transformer(cfg, tp_size=args.tp_size,
                     cp_size=args.cp_size, cp_impl=args.cp_impl,
+                    cp_layout=args.cp_layout,
                     sequence_parallel=args.sequence_parallel,
                     remat=REMAT_CHOICES[args.remat])
     print(f"model: {cfg.num_params()/1e6:.2f}M params, vocab={vocab_size}, "
@@ -278,6 +284,13 @@ def train(args: argparse.Namespace) -> dict:
             async_write=True)
         last_saved = step
 
+    def shutdown_save(step):
+        """Shared by both shutdown exits (per-batch poll and post-loop)."""
+        if step > last_saved:
+            schedule_save(step)
+        print(f"shutdown requested: checkpointed at step {step}; "
+              f"restart with --resume to continue")
+
     batch_buf = []  # batches awaiting one (possibly multi-step) dispatch
     try:
         for epoch in range(start_epoch, max_epoch):
@@ -292,10 +305,7 @@ def train(args: argparse.Namespace) -> dict:
                 # next dispatch launches.
                 if shutdown.requested:
                     batch_buf = []
-                    if n > last_saved:
-                        schedule_save(n)
-                    print(f"shutdown requested: checkpointed at step {n}; "
-                          f"restart with --resume to continue")
+                    shutdown_save(n)
                     done = True
                     break
                 # Buffer up to `spd` batches, then run them as ONE dispatch
@@ -358,10 +368,8 @@ def train(args: argparse.Namespace) -> dict:
         # via the max_steps break without passing the per-batch poll — it
         # must still checkpoint the trained state (the pre-multi-dispatch
         # code polled after every step and caught this window).
-        if shutdown.requested and n > last_saved:
-            schedule_save(n)
-            print(f"shutdown requested: checkpointed at step {n}; "
-                  f"restart with --resume to continue")
+        if shutdown.requested:
+            shutdown_save(n)
     finally:
         # On ANY exit (including a raising step): let the in-flight async
         # write finish so no truncated npz is left behind, and put the

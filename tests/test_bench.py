@@ -3,10 +3,13 @@ line on stdout with the metric/value/unit/vs_baseline keys, whatever flags
 are set. Runs the real harness on the virtual CPU mesh at a tiny shape."""
 
 import json
+import os
 import subprocess
 import sys
 
 import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.parametrize("extra", [
@@ -23,7 +26,7 @@ def test_bench_emits_one_json_line(extra):
             "import bench;"
             "bench.main(['--model','tiny','--batch','2','--seqlen','64',"
             "'--iters','1'] + %r)" % (extra,))],
-        capture_output=True, text=True, timeout=500, cwd="/root/repo")
+        capture_output=True, text=True, timeout=500, cwd=REPO_ROOT)
     assert p.returncode == 0, p.stderr[-2000:]
     lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
