@@ -63,6 +63,9 @@ def get_eval_args(argv=None) -> argparse.Namespace:
                    help="context-parallel axis for the validation forward "
                         "(ring attention over sequence chunks); decoding "
                         "always runs the cp=1 path on the same params")
+    g.add_argument("--cp_layout", choices=["contiguous", "zigzag"],
+                   default="contiguous",
+                   help="sequence layout over the cp ring (see train.py)")
 
     g = p.add_argument_group("data")
     g.add_argument("--data_path", "-d", required=True)
@@ -242,7 +245,8 @@ def evaluate(args: argparse.Namespace) -> dict:
                       compute_dtype="bfloat16" if args.bf16 else "float32")
     # val loss runs the full 3-D mesh; decoding runs the cp=1 path on the
     # same params (models/decode.py), with its batch replicated over dp/cp.
-    model_val = Transformer(cfg, tp_size=args.tp_size, cp_size=args.cp_size)
+    model_val = Transformer(cfg, tp_size=args.tp_size, cp_size=args.cp_size,
+                            cp_layout=args.cp_layout)
     model = Transformer(cfg, tp_size=args.tp_size)
     template = model.init(jax.random.key(args.random_seed))
     loss_fn = build_eval_loss(model_val, mesh)

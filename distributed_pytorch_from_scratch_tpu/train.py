@@ -367,8 +367,10 @@ def train(args: argparse.Namespace) -> dict:
         # A signal that lands during the run's FINAL dispatch exits the loop
         # via the max_steps break without passing the per-batch poll — it
         # must still checkpoint the trained state (the pre-multi-dispatch
-        # code polled after every step and caught this window).
-        if shutdown.requested:
+        # code polled after every step and caught this window). The
+        # n > last_saved guard keeps a signal the poll already handled from
+        # printing the shutdown message twice.
+        if shutdown.requested and n > last_saved:
             shutdown_save(n)
     finally:
         # On ANY exit (including a raising step): let the in-flight async

@@ -93,9 +93,11 @@ def test_train_eval_resume_e2e(corpus):
     # batch (ragged final batch padded with IGNORE_INDEX rows), cp runs ring
     # attention over sequence chunks
     # --no_kv_cache: the full-recompute decode must also run on the 3-D
-    # mesh (its buffer is replicated over dp/cp, not sharded)
+    # mesh (its buffer is replicated over dp/cp, not sharded); zigzag
+    # exercises the balanced ring layout through the eval CLI
     result3d = eval_mod.evaluate(eval_mod.get_eval_args([
         "--tp_size", "2", "--dp_size", "2", "--cp_size", "2",
+        "--cp_layout", "zigzag",
         "--ckpt_dir", save_dir,
         "--data_path", str(corpus["tokens"]),
         "--tokenizer_path", str(corpus["tok"]),
