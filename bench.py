@@ -125,10 +125,14 @@ def main(argv=None):
             compile_s = time.time() - t0
             break
         except Exception as e:  # noqa: BLE001 — any compile/OOM failure
-            last_err = e
+            # keep only the message: the exception's traceback frames pin the
+            # failed attempt's params/opt buffers in HBM, which would make
+            # the OOM-recovery retry itself OOM
+            last_err = f"{type(e).__name__}: {str(e)[:300]}"
+            params = opt_state = step_fn = None  # noqa: F841 — drop buffers
             print(f"bench: config (remat={remat_used}, attn={attn_used}) "
-                  f"failed ({type(e).__name__}: {str(e)[:200]}); trying the "
-                  f"next fallback", file=sys.stderr)
+                  f"failed ({last_err[:200]}); trying the next fallback",
+                  file=sys.stderr)
     else:
         raise SystemExit(f"bench: every fallback failed; last: {last_err}")
 

@@ -72,6 +72,10 @@ def get_eval_args(argv=None) -> argparse.Namespace:
     g.add_argument("--tokenizer_path", "-t", required=True)
 
     g = p.add_argument_group("model")
+    g.add_argument("--family", choices=["llama", "gpt2"], default="llama",
+                   help="must match the trained model family; gpt2 decodes "
+                        "via the full-recompute path (its KV-cache decoder "
+                        "is llama-specific)")
     g.add_argument("--ckpt_dir", required=True)
     g.add_argument("--model", choices=sorted(MODEL_PRESETS), default=None,
                    help="named shape preset; must match the trained model "
@@ -245,9 +249,18 @@ def evaluate(args: argparse.Namespace) -> dict:
                       compute_dtype="bfloat16" if args.bf16 else "float32")
     # val loss runs the full 3-D mesh; decoding runs the cp=1 path on the
     # same params (models/decode.py), with its batch replicated over dp/cp.
-    model_val = Transformer(cfg, tp_size=args.tp_size, cp_size=args.cp_size,
-                            cp_layout=args.cp_layout)
-    model = Transformer(cfg, tp_size=args.tp_size)
+    if args.family == "gpt2":
+        if args.cp_size > 1:
+            raise SystemExit("--family gpt2 supports dp x tp only")
+        from .models.gpt2 import GPT2Transformer
+        model_val = GPT2Transformer(cfg, tp_size=args.tp_size)
+        model = model_val
+        args.no_kv_cache = True  # KV decoder is llama-specific
+    else:
+        model_val = Transformer(cfg, tp_size=args.tp_size,
+                                cp_size=args.cp_size,
+                                cp_layout=args.cp_layout)
+        model = Transformer(cfg, tp_size=args.tp_size)
     template = model.init(jax.random.key(args.random_seed))
     loss_fn = build_eval_loss(model_val, mesh)
 

@@ -91,11 +91,83 @@ class VanillaTransformer:
     def loss(self, params: Params, input_ids: jax.Array, target_ids: jax.Array,
              position_ids: jax.Array) -> jax.Array:
         logits = self.forward(params, input_ids, position_ids).astype(jnp.float32)
-        valid = target_ids != IGNORE_INDEX
-        tgt = jnp.where(valid, target_ids, 0)
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
-        token_loss = lse - tgt_logit
-        loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
-        count = jnp.sum(valid.astype(jnp.float32))
-        return loss_sum / jnp.maximum(count, 1.0)
+        return _masked_ce(logits, target_ids)
+
+
+def _masked_ce(logits: jax.Array, target_ids: jax.Array) -> jax.Array:
+    valid = target_ids != IGNORE_INDEX
+    tgt = jnp.where(valid, target_ids, 0)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt_logit = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    token_loss = lse - tgt_logit
+    loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
+    count = jnp.sum(valid.astype(jnp.float32))
+    return loss_sum / jnp.maximum(count, 1.0)
+
+
+def _layer_norm(p: Params, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    normed = ((xf - mean) * lax.rsqrt(var + eps)).astype(x.dtype)
+    return p["scale"].astype(x.dtype) * normed + p["bias"].astype(x.dtype)
+
+
+@dataclass(frozen=True)
+class VanillaGPT2:
+    """Unsharded oracle twin for the GPT-2 family (`models/gpt2.py`):
+    LayerNorm + GELU(tanh) MLP + learned positions + tied embedding head.
+    Independent implementation consuming the same parameter pytree
+    `GPT2Transformer.init` produces."""
+
+    cfg: ModelConfig
+
+    def forward(self, params: Params, input_ids: jax.Array,
+                position_ids: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dtype = resolve_dtype(cfg.compute_dtype)
+        h = cfg.head_dim
+
+        emb = params["embedding"]["weight"]      # (vocab_padded, d)
+        x = jnp.take(emb, input_ids, axis=0)
+        pos = jnp.take(params["pos_embedding"]["weight"], position_ids,
+                       axis=0, mode="clip")
+        x = (x + pos).astype(dtype)
+
+        def body(x, lp):
+            b, t, d = x.shape
+            y = _layer_norm(lp["ln1"], x)
+            q = _linear(lp["wq"], y, dtype)
+            k = _linear(lp["wk"], y, dtype)
+            v = _linear(lp["wv"], y, dtype)
+            split = lambda z: z.reshape(b, t, cfg.num_heads, h).transpose(0, 2, 1, 3)
+            q, k, v = split(q), split(k), split(v)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(h)
+            mask = jnp.triu(jnp.ones((t, t), dtype=bool), k=1)
+            scores = jnp.where(mask[None, None],
+                               jnp.asarray(-10000.0, scores.dtype), scores)
+            probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
+            x = x + _linear(lp["wo"], o, dtype)
+
+            y = _layer_norm(lp["ln2"], x)
+            x = x + _linear(lp["proj"],
+                            jax.nn.gelu(_linear(lp["fc"], y, dtype),
+                                        approximate=True), dtype)
+            return x, None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        x = _layer_norm(params["norm"], x)
+        logits = x @ emb.astype(dtype).T          # tied head
+        vocab_padded = logits.shape[-1]
+        if vocab_padded != cfg.vocab_size:
+            col = jnp.arange(vocab_padded)
+            logits = jnp.where(col[None, None, :] < cfg.vocab_size, logits,
+                               jnp.asarray(-1e9, logits.dtype))
+        return logits
+
+    def loss(self, params: Params, input_ids: jax.Array, target_ids: jax.Array,
+             position_ids: jax.Array) -> jax.Array:
+        logits = self.forward(params, input_ids, position_ids).astype(jnp.float32)
+        return _masked_ce(logits, target_ids)

@@ -36,6 +36,9 @@ class VocabParallelEmbedding:
     hdim: int
     axis: str = "tp"
     tp_size: int = 1  # static: needed to size the padded table at init time
+    # 1.0 = the reference's nn.Embedding-default normal(0, 1)
+    # (`layers.py:114`); the GPT-2 family uses its own 0.02 (models/gpt2.py)
+    init_std: float = 1.0
 
     @property
     def vocab_padded(self) -> int:
@@ -43,9 +46,10 @@ class VocabParallelEmbedding:
         return ((self.vocab_size + n - 1) // n) * n
 
     def init(self, key: jax.Array) -> Params:
-        # normal(0, 1) like the reference (`layers.py:114`, "the same as
-        # pytorch default" for nn.Embedding).
-        w = jax.random.normal(key, (self.vocab_size, self.hdim), jnp.float32)
+        # normal(0, init_std); 1.0 matches the reference (`layers.py:114`,
+        # "the same as pytorch default" for nn.Embedding).
+        w = self.init_std * jax.random.normal(
+            key, (self.vocab_size, self.hdim), jnp.float32)
         if self.vocab_padded != self.vocab_size:
             pad = jnp.zeros((self.vocab_padded - self.vocab_size, self.hdim), jnp.float32)
             w = jnp.concatenate([w, pad], axis=0)

@@ -1,8 +1,10 @@
-"""RMSNorm — replicated (not parallel), computed in f32.
+"""RMSNorm + LayerNorm — replicated (not parallel), computed in f32.
 
-Reference: `/root/reference/models/layers.py:145-155` ("Borrowed from LLama"):
-`scale * x * rsqrt(mean(x^2) + eps)`, with the normalisation in f32 and the
-result cast back to the input dtype. eps=1e-5.
+RMSNorm mirrors `/root/reference/models/layers.py:145-155` ("Borrowed from
+LLama"): `scale * x * rsqrt(mean(x^2) + eps)`, f32 compute, cast back.
+LayerNorm (scale + bias, mean-centered) serves the GPT-2 model family
+(`models/gpt2.py`) — the reference has no GPT-2 family; this is a framework
+extension built on the same functional-module pattern. eps=1e-5 for both.
 """
 
 from __future__ import annotations
@@ -33,3 +35,25 @@ class RMSNorm:
         xf = x.astype(jnp.float32)
         normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
         return (params["scale"].astype(x.dtype) * normed.astype(x.dtype))
+
+
+@dataclass(frozen=True)
+class LayerNorm:
+    hdim: int
+    eps: float = 1e-5
+
+    def init(self, key: jax.Array) -> Params:
+        del key
+        return {"scale": jnp.ones((self.hdim,), jnp.float32),
+                "bias": jnp.zeros((self.hdim,), jnp.float32)}
+
+    def specs(self) -> Params:
+        return {"scale": P(None), "bias": P(None)}
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        normed = ((xf - mean) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
+        return (params["scale"].astype(x.dtype) * normed
+                + params["bias"].astype(x.dtype))

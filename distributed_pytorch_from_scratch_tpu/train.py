@@ -91,6 +91,11 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "logs/saves land on dispatch boundaries")
 
     g = p.add_argument_group("model")
+    g.add_argument("--family", choices=["llama", "gpt2"], default="llama",
+                   help="model family: 'llama' = the reference architecture "
+                        "(RoPE/RMSNorm/SwiGLU), 'gpt2' = LayerNorm/GELU/"
+                        "learned positions/tied embeddings (models/gpt2.py; "
+                        "dp x tp only)")
     g.add_argument("--model", choices=sorted(MODEL_PRESETS), default=None,
                    help="named shape preset (BASELINE configs: '45m' is the "
                         "reference shape, 'gpt2-124m' is config 3); explicit "
@@ -178,6 +183,9 @@ def train(args: argparse.Namespace) -> dict:
     if args.batch_size % args.dp_size != 0:
         raise SystemExit(f"--batch_size {args.batch_size} must be divisible "
                          f"by --dp_size {args.dp_size}")
+    if args.family == "gpt2" and (args.cp_size > 1 or args.sequence_parallel):
+        raise SystemExit("--family gpt2 supports the dp x tp mesh only "
+                         "(no --cp_size/--sequence_parallel)")
     mesh = make_mesh(mesh_cfg)
 
     dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -191,19 +199,27 @@ def train(args: argparse.Namespace) -> dict:
                       num_layers=pick(args.num_layers, preset.num_layers),
                       vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
-    model = Transformer(cfg, tp_size=args.tp_size,
-                    cp_size=args.cp_size, cp_impl=args.cp_impl,
-                    cp_layout=args.cp_layout,
-                    sequence_parallel=args.sequence_parallel,
-                    remat=REMAT_CHOICES[args.remat])
-    print(f"model: {cfg.num_params()/1e6:.2f}M params, vocab={vocab_size}, "
-          f"mesh=dp{args.dp_size} x cp{args.cp_size} x tp{args.tp_size}, "
-          f"compute={cfg.compute_dtype}")
-
+    if args.family == "gpt2":
+        from .models.gpt2 import GPT2Transformer
+        model = GPT2Transformer(cfg, tp_size=args.tp_size,
+                                remat=REMAT_CHOICES[args.remat])
+    else:
+        model = Transformer(cfg, tp_size=args.tp_size,
+                        cp_size=args.cp_size, cp_impl=args.cp_impl,
+                        cp_layout=args.cp_layout,
+                        sequence_parallel=args.sequence_parallel,
+                        remat=REMAT_CHOICES[args.remat])
     ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
                            max_steps=args.max_steps)
 
     params = model.init(jax.random.key(args.random_seed))
+    # count from the actual pytree: exact for every family (cfg.num_params()
+    # hardcodes the llama layout — untied head, SwiGLU, no position table)
+    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    print(f"model[{args.family}]: {n_params/1e6:.2f}M params, "
+          f"vocab={vocab_size}, "
+          f"mesh=dp{args.dp_size} x cp{args.cp_size} x tp{args.tp_size}, "
+          f"compute={cfg.compute_dtype}")
     opt_state = init_adam_state(params)
     start_step = 0
     if args.resume:

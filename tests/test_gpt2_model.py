@@ -1,0 +1,181 @@
+"""GPT-2 model family vs its unsharded oracle — same three-check idiom as
+the LLaMA-family equivalence suite (SURVEY §4): shared init pytree, forward
+allclose, grads allclose, and a multi-step training-history check. The tied
+embedding head is the interesting part: the embedding weight's gradient must
+carry BOTH the lookup and lm-head contributions across the vocab-parallel
+shards."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_from_scratch_tpu import MeshConfig, make_mesh
+from distributed_pytorch_from_scratch_tpu.config import (IGNORE_INDEX,
+                                                         ModelConfig,
+                                                         OptimizerConfig)
+from distributed_pytorch_from_scratch_tpu.models.gpt2 import GPT2Transformer
+from distributed_pytorch_from_scratch_tpu.models.vanilla import VanillaGPT2
+from distributed_pytorch_from_scratch_tpu.training.optim import (
+    adam_update, init_adam_state)
+from distributed_pytorch_from_scratch_tpu.training.train_step import (
+    build_train_step)
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                  vocab_size=96, maxlen=64)
+
+
+def make_batch(key, batch=4, t=32, vocab=96):
+    k1, k2 = jax.random.split(key)
+    ids = jax.random.randint(k1, (batch, t), 0, vocab)
+    tgt = jax.random.randint(k2, (batch, t), 0, vocab)
+    mask = jax.random.bernoulli(jax.random.fold_in(key, 9), 0.2, (batch, t))
+    tgt = jnp.where(mask, IGNORE_INDEX, tgt)
+    pos = jnp.tile(jnp.arange(t)[None, :], (batch, 1))
+    return ids, tgt, pos
+
+
+def test_param_tree_is_tied():
+    """No separate lm_head params — the head IS the embedding table."""
+    model = GPT2Transformer(CFG, tp_size=4)
+    params = model.init(jax.random.key(0))
+    assert set(params) == {"embedding", "pos_embedding", "layers", "norm"}
+    assert params["embedding"]["weight"].shape == (96, 32)
+    assert set(params["layers"]) == {"ln1", "wq", "wk", "wv", "wo",
+                                     "ln2", "fc", "proj"}
+    # specs tree mirrors the param tree exactly
+    jax.tree.map(lambda *_: None, params, model.specs())
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 4), (2, 2), (1, 1)])
+def test_loss_and_grads_match_vanilla(dp, tp):
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    model = GPT2Transformer(CFG, tp_size=tp)
+    oracle = VanillaGPT2(CFG)
+    params = model.init(jax.random.key(0))
+    ids, tgt, pos = make_batch(jax.random.key(2))
+
+    l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(params, ids, tgt, pos)
+    l_ref, g_ref = jax.value_and_grad(oracle.loss)(params, ids, tgt, pos)
+
+    np.testing.assert_allclose(l_sh, l_ref, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_forward_logits_match_vanilla():
+    mesh = make_mesh(MeshConfig(dp=1, tp=4))
+    model = GPT2Transformer(CFG, tp_size=4)
+    oracle = VanillaGPT2(CFG)
+    params = model.init(jax.random.key(0))
+    ids, _, pos = make_batch(jax.random.key(1))
+    logits_sh = model.make_forward(mesh)(params, ids, pos)
+    logits_ref = oracle.forward(params, ids, pos)
+    np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(logits_ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_nondivisible_vocab_padding():
+    """vocab 90 over tp=4 -> padded to 92; padded logits masked, loss equal
+    to the oracle's."""
+    cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=1,
+                      vocab_size=90, maxlen=32)
+    mesh = make_mesh(MeshConfig(dp=1, tp=4))
+    model = GPT2Transformer(cfg, tp_size=4)
+    assert model.vocab_padded == 92
+    oracle = VanillaGPT2(cfg)
+    params = model.init(jax.random.key(3))
+    assert params["embedding"]["weight"].shape == (92, 32)
+    ids, tgt, pos = make_batch(jax.random.key(4), vocab=90)
+    l_sh = model.make_loss(mesh)(params, ids, tgt, pos)
+    l_ref = oracle.loss(params, ids, tgt, pos)
+    np.testing.assert_allclose(l_sh, l_ref, rtol=1e-5)
+
+
+def test_multi_step_training_history_matches_vanilla():
+    """20 Adam steps: parallel and oracle losses track each other — the
+    reference's strongest equivalence check (1000-step history,
+    `/root/reference/tests/*:111-135`), shortened for CI."""
+    mesh = make_mesh(MeshConfig(dp=2, tp=2))
+    model = GPT2Transformer(CFG, tp_size=2)
+    oracle = VanillaGPT2(CFG)
+    ocfg = OptimizerConfig(lr=1e-3, warmup_steps=2, max_steps=30)
+
+    p_sh = jax.device_put(model.init(jax.random.key(0)),
+                          model.shardings(mesh))
+    o_sh = init_adam_state(p_sh)
+    step_sh = build_train_step(model, mesh, ocfg)
+
+    p_v = model.init(jax.random.key(0))
+    o_v = init_adam_state(p_v)
+    grad_v = jax.jit(jax.value_and_grad(oracle.loss))
+
+    @jax.jit
+    def step_v(p, o, ids, tgt, pos):
+        loss, g = grad_v(p, ids, tgt, pos)
+        p, o = adam_update(ocfg, p, g, o)
+        return p, o, loss
+
+    # one FIXED batch: repeated optimization must drive its loss down,
+    # giving the histories real dynamics to diverge on if the tied-head
+    # gradients were wrong anywhere
+    ids, tgt, pos = make_batch(jax.random.key(100))
+    hist_sh, hist_v = [], []
+    for s in range(20):
+        p_sh, o_sh, l1 = step_sh(p_sh, o_sh, ids, tgt, pos)
+        p_v, o_v, l2 = step_v(p_v, o_v, ids, tgt, pos)
+        hist_sh.append(float(l1))
+        hist_v.append(float(l2))
+    np.testing.assert_allclose(hist_sh, hist_v, rtol=0, atol=1e-4)
+    assert hist_sh[-1] < hist_sh[0] - 0.1, hist_sh
+
+
+def test_cli_family_gpt2_train_eval(tmp_path):
+    """--family gpt2 end to end: train with checkpoints, evaluate val loss +
+    greedy decode (full-recompute path — the KV decoder is llama-only)."""
+    import json
+
+    from distributed_pytorch_from_scratch_tpu import evaluate as eval_mod
+    from distributed_pytorch_from_scratch_tpu import train as train_mod
+    from distributed_pytorch_from_scratch_tpu.data.tokenizer import (
+        pre_tokenize, train_bpe)
+
+    texts = ["the king rode out at dawn with his men",
+             "a quiet morning on the river bank",
+             "Nice to meet you, it's a Great day; Your majesty, I shall be glad",
+             "What a glory to see; Shame for the weak, The brave man ne, "
+             "Poor old man"] * 6
+    tj = tmp_path / "texts.json"
+    json.dump({"train": texts, "validation": texts[:4]}, open(tj, "w"))
+    train_bpe(str(tj), str(tmp_path / "tok.json"), vocab_size=300)
+    pre_tokenize(str(tj), str(tmp_path / "tokens.json"),
+                 str(tmp_path / "tok.json"))
+
+    flags = ["--family", "gpt2", "--attn_dim", "32", "--ffn_dim", "64",
+             "--num_heads", "4", "--num_layers", "2", "--maxlen", "32"]
+    r = train_mod.train(train_mod.get_train_args(
+        ["--data_path", str(tmp_path / "tokens.json"),
+         "--save_dir", str(tmp_path / "ck"),
+         "--tp_size", "2", "--dp_size", "2",
+         "--batch_size", "4", "--max_steps", "6", "--save_interval", "3",
+         "--log_interval", "3", "--warmup_steps", "2", *flags]))
+    assert r["steps"] == 6 and np.isfinite(r["avg_loss"])
+
+    result = eval_mod.evaluate(eval_mod.get_eval_args(
+        ["--ckpt_dir", str(tmp_path / "ck"),
+         "--data_path", str(tmp_path / "tokens.json"),
+         "--tokenizer_path", str(tmp_path / "tok.json"),
+         "--tp_size", "2", "--max_decode_len", "8", "--no-bf16", *flags]))
+    assert set(result["val_losses"]) == {3, 6}
+    assert all(np.isfinite(v) for v in result["val_losses"].values())
+    assert len(result["decoded"]) == len(eval_mod.DECODE_PROMPTS)
+
+
+def test_cli_family_gpt2_rejects_cp():
+    from distributed_pytorch_from_scratch_tpu import train as train_mod
+
+    with pytest.raises(SystemExit, match="dp x tp"):
+        train_mod.train(train_mod.get_train_args(
+            ["--family", "gpt2", "--cp_size", "2", "--data_path", "x.json",
+             "--max_steps", "1"]))
