@@ -52,6 +52,10 @@ class ModelConfig:
     vocab_size: int = 1024
     maxlen: int = 1000
     rope_theta: float = 10000.0
+    # Grouped-query attention: number of K/V heads (each shared by
+    # num_heads/num_kv_heads query heads). None = num_heads = the
+    # reference's plain multi-head attention.
+    num_kv_heads: "int | None" = None
     # Dtype used for matmuls/activations inside the forward pass. Parameters
     # and the loss always stay float32 (the reference's autocast semantics:
     # `/root/reference/train.py:99-104`).
@@ -61,6 +65,15 @@ class ModelConfig:
     def head_dim(self) -> int:
         assert self.attn_dim % self.num_heads == 0
         return self.attn_dim // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads if self.num_kv_heads is not None else self.num_heads
+
+    @property
+    def kv_dim(self) -> int:
+        """Output width of wk/wv: kv_heads * head_dim (== attn_dim for MHA)."""
+        return self.kv_heads * self.head_dim
 
     def padded_vocab_size(self, tp_size: int) -> int:
         """Vocab size rounded up to a multiple of tp_size.
@@ -74,7 +87,8 @@ class ModelConfig:
 
     def num_params(self) -> int:
         d, f, v, L = self.attn_dim, self.ffn_dim, self.vocab_size, self.num_layers
-        attn = 4 * d * d + 4 * d                 # wq/wk/wv/wo weights + biases
+        kd = self.kv_dim
+        attn = 2 * d * d + 2 * d * kd + 2 * d + 2 * kd  # wq/wo + wk/wv (+ biases)
         ffn = 3 * d * f + 2 * f + d              # gate/up/down weights + biases
         norms = 2 * d
         return v * d + L * (attn + ffn + norms) + d + v * d + v  # emb + layers + final norm + lm_head
@@ -143,6 +157,11 @@ class OptimizerConfig:
     cycle_momentum: bool = True
     base_momentum: float = 0.85
     max_momentum: float = 0.95
+    # Global-norm gradient clipping (torch clip_grad_norm_ semantics: one
+    # norm over ALL grads, scale = max_norm / (norm + 1e-6) when exceeded).
+    # None = off — the reference has no clipping (SURVEY non-goals), so off
+    # stays the parity default.
+    clip_grad_norm: "float | None" = None
 
 
 @dataclass(frozen=True)

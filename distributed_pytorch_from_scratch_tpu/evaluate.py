@@ -83,6 +83,8 @@ def get_eval_args(argv=None) -> argparse.Namespace:
     g.add_argument("--attn_dim", type=int, default=None)
     g.add_argument("--ffn_dim", type=int, default=None)
     g.add_argument("--num_heads", type=int, default=None)
+    g.add_argument("--num_kv_heads", type=int, default=None,
+                   help="must match the trained model (GQA, llama family)")
     g.add_argument("--num_layers", type=int, default=None)
     g.add_argument("--maxlen", type=int, default=None)
     g.add_argument("--bf16", action="store_true", default=True)
@@ -172,6 +174,19 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
     # one fixed buffer for every prompt (single compile); leave room for BOS
     # and at least one generated token even if a prompt is near the cap
     buf_len = max(max_decode_len + 1, max(len(i) for i in encoded.values()) + 2)
+    # models with learned position embeddings (gpt2 family) hard-cap the
+    # buffer at maxlen — positions past the table would silently clip to
+    # its last row and degrade generations
+    cap = getattr(model, "max_decode_positions", None)
+    if cap is not None and buf_len > cap:
+        longest = max(len(i) for i in encoded.values())
+        if cap < longest + 2:
+            raise SystemExit(
+                f"prompts need {longest + 2} positions but the model's "
+                f"learned position table has only {cap}")
+        print(f"Warning: clamping decode buffer {buf_len} -> {cap} (learned "
+              f"position table size); reduce --max_decode_len to silence")
+        buf_len = cap
 
     if use_kv_cache:
         # ONE device dispatch for the whole prompt set: decode_batch handles
@@ -244,6 +259,8 @@ def evaluate(args: argparse.Namespace) -> dict:
     cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
                       ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
                       num_heads=pick(args.num_heads, preset.num_heads),
+                      num_kv_heads=pick(args.num_kv_heads,
+                                        preset.num_kv_heads),
                       num_layers=pick(args.num_layers, preset.num_layers),
                       vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")

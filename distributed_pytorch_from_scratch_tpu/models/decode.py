@@ -40,14 +40,23 @@ Params = Dict[str, Any]
 
 
 def _qkv(model: Transformer, lp: Params, y: jax.Array, dtype):
-    """Project y (b, t, d) -> per-head q, k, v (b, local_heads, t, hd)."""
+    """Project y (b, t, d) -> per-head q, k, v (b, local_heads, t, hd).
+
+    Under grouped-query attention the kv heads are repeated to the query
+    head count here, so the caches below store group-expanded K/V — correct
+    for any num_kv_heads; keeping the caches at kv_heads (the GQA memory
+    win) is a future optimisation of this decoder."""
     m = model._mods
     b, t, _ = y.shape
     h = model.cfg.head_dim
-    split = lambda z: z.reshape(b, t, model.num_local_heads, h).transpose(0, 2, 1, 3)
-    q = split(m["wq"].apply(lp["wq"], y, dtype))
-    k = split(m["wk"].apply(lp["wk"], y, dtype))
-    v = split(m["wv"].apply(lp["wv"], y, dtype))
+    split = lambda z, nh: z.reshape(b, t, nh, h).transpose(0, 2, 1, 3)
+    q = split(m["wq"].apply(lp["wq"], y, dtype), model.num_local_heads)
+    k = split(m["wk"].apply(lp["wk"], y, dtype), model.num_local_kv_heads)
+    v = split(m["wv"].apply(lp["wv"], y, dtype), model.num_local_kv_heads)
+    group = model.num_local_heads // model.num_local_kv_heads
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     return q, k, v
 
 

@@ -49,7 +49,7 @@ from ..parallel.embedding import VocabParallelEmbedding
 from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
 from ..parallel.norm import LayerNorm
 from ..runtime.prng import fold
-from .transformer import NEG_INF, Transformer
+from .transformer import NEG_INF, Transformer, remat_wrap
 
 Params = Dict[str, Any]
 
@@ -82,12 +82,22 @@ class GPT2Transformer:
             raise ValueError(
                 f"attn_dim {cfg.attn_dim} and ffn_dim {cfg.ffn_dim} must be "
                 f"divisible by tp_size {tp}")
+        if cfg.kv_heads != cfg.num_heads:
+            raise ValueError("grouped-query attention (num_kv_heads) is a "
+                             "llama-family feature; the gpt2 family is MHA")
 
     # ---- static properties ----
 
     @property
     def d(self) -> int:
         return self.cfg.attn_dim
+
+    @property
+    def max_decode_positions(self) -> int:
+        """Learned position embeddings hard-cap the sequence at maxlen —
+        unlike RoPE, there is no table to extend (decode callers clamp
+        their buffers; see evaluate.greedy_decode)."""
+        return self.cfg.maxlen
 
     @property
     def vocab_padded(self) -> int:
@@ -187,16 +197,7 @@ class GPT2Transformer:
                        axis=0, mode="clip")
         x = (x + pos).astype(dtype)
 
-        layer_fn = self._layer_body
-        if self.remat == "dots":
-            layer_fn = jax.checkpoint(
-                layer_fn, static_argnums=(2,),
-                policy=jax.checkpoint_policies.save_from_both_policies(
-                    jax.checkpoint_policies.checkpoint_dots,
-                    jax.checkpoint_policies.save_only_these_names(
-                        "flash_out", "flash_lse")))
-        elif self.remat:
-            layer_fn = jax.checkpoint(layer_fn, static_argnums=(2,))
+        layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(2,))
 
         def body(carry, lp):
             return layer_fn(carry, lp, dtype), None

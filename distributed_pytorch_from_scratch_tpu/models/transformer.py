@@ -55,6 +55,27 @@ Params = Dict[str, Any]
 NEG_INF = -1e9  # mask value for padded vocab logits
 
 
+def remat_wrap(layer_fn, remat, static_argnums=()):
+    """Apply a per-layer remat policy; shared by every model family.
+
+    'dots' = checkpoint_dots saves matmul outputs; additionally pin the
+    flash kernel's o/lse residuals (tagged via checkpoint_name in
+    ops/pallas/flash_attention.py) so the backward pass never re-runs the
+    forward attention kernel. On the XLA attention path the tags don't
+    exist and the policy degrades gracefully.
+    """
+    if remat == "dots":
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
+        return jax.checkpoint(layer_fn, static_argnums=static_argnums,
+                              policy=policy)
+    if remat:
+        return jax.checkpoint(layer_fn, static_argnums=static_argnums)
+    return layer_fn
+
+
 @dataclass(frozen=True)
 class Transformer:
     """Static model definition; params live in an explicit pytree."""
@@ -114,6 +135,12 @@ class Transformer:
             raise ValueError(
                 f"attn_dim {cfg.attn_dim} and ffn_dim {cfg.ffn_dim} must be "
                 f"divisible by tp_size {tp}")
+        if cfg.num_heads % cfg.kv_heads != 0:
+            raise ValueError(f"num_heads {cfg.num_heads} must be a multiple "
+                             f"of num_kv_heads {cfg.kv_heads}")
+        if cfg.kv_heads % tp != 0:
+            raise ValueError(f"num_kv_heads {cfg.kv_heads} not divisible by "
+                             f"tp_size {tp}")
         if self.cp_impl not in ("ring", "ulysses"):
             raise ValueError(f"cp_impl must be 'ring' or 'ulysses', got "
                              f"{self.cp_impl!r}")
@@ -145,6 +172,10 @@ class Transformer:
             f"num_heads {self.cfg.num_heads} not divisible by tp {self.tp_size}")
         return self.cfg.num_heads // self.tp_size
 
+    @property
+    def num_local_kv_heads(self) -> int:
+        return self.cfg.kv_heads // self.tp_size
+
     @functools.cached_property
     def embedding(self) -> VocabParallelEmbedding:
         return VocabParallelEmbedding(self.cfg.vocab_size, self.d, tp_size=self.tp_size)
@@ -152,10 +183,11 @@ class Transformer:
     @functools.cached_property
     def _mods(self) -> Dict[str, Any]:
         d, f = self.d, self.cfg.ffn_dim
+        kd = self.cfg.kv_dim  # < d under grouped-query attention
         return {
             "wq": ColumnParallelLinear(d, d, gather_output=False),
-            "wk": ColumnParallelLinear(d, d, gather_output=False),
-            "wv": ColumnParallelLinear(d, d, gather_output=False),
+            "wk": ColumnParallelLinear(d, kd, gather_output=False),
+            "wv": ColumnParallelLinear(d, kd, gather_output=False),
             "wo": RowParallelLinear(d, d, split_input=False),
             "gate_proj": ColumnParallelLinear(d, f, gather_output=False),
             "up_proj": ColumnParallelLinear(d, f, gather_output=False),
@@ -247,10 +279,20 @@ class Transformer:
         q = m["wq"].apply(layer_params["wq"], y, dtype, input_layout=in_layout)
         k = m["wk"].apply(layer_params["wk"], y, dtype, input_layout=in_layout)
         v = m["wv"].apply(layer_params["wv"], y, dtype, input_layout=in_layout)
-        # (b, t, local_heads*h) -> (b, local_heads, t, h)
-        split_heads = lambda z: z.reshape(b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
-        q, k, v = split_heads(q), split_heads(k), split_heads(v)
+        # (b, t, heads*h) -> (b, heads, t, h); under grouped-query attention
+        # wk/wv produce fewer heads, each then repeated across its query
+        # group so every attention impl (flash/XLA/ring/ulysses) sees equal
+        # head counts. The params/optimizer/KV-projection savings are real;
+        # a grouped flash kernel would also save the repeat's HBM.
+        split = lambda z, nh: z.reshape(b, t, nh, h).transpose(0, 2, 1, 3)
+        q = split(q, self.num_local_heads)
+        k = split(k, self.num_local_kv_heads)
+        v = split(v, self.num_local_kv_heads)
         q, k = apply_rotary(q, k, cos, sin)
+        group = self.num_local_heads // self.num_local_kv_heads
+        if group > 1:
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
         if self.cp_size > 1:
             if self.cp_impl == "ring":
                 o = ring_attention(q, k, v, pos, axis="cp")
@@ -298,21 +340,7 @@ class Transformer:
         cos = jnp.take(cos_t, position_ids, axis=0, mode="clip")  # (b, t, head_dim)
         sin = jnp.take(sin_t, position_ids, axis=0, mode="clip")
 
-        layer_fn = self._layer_body
-        if self.remat == "dots":
-            # checkpoint_dots saves matmul outputs; additionally pin the
-            # flash kernel's o/lse residuals (tagged via checkpoint_name in
-            # ops/pallas/flash_attention.py) so the backward pass never
-            # re-runs the forward attention kernel. On the XLA attention
-            # path the tags don't exist and the policy degrades gracefully.
-            policy = jax.checkpoint_policies.save_from_both_policies(
-                jax.checkpoint_policies.checkpoint_dots,
-                jax.checkpoint_policies.save_only_these_names(
-                    "flash_out", "flash_lse"))
-            layer_fn = jax.checkpoint(
-                layer_fn, static_argnums=(5,), policy=policy)
-        elif self.remat:
-            layer_fn = jax.checkpoint(layer_fn, static_argnums=(5,))
+        layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(5,))
 
         def body(carry, layer_params):
             return layer_fn(carry, layer_params, cos, sin, position_ids,

@@ -61,9 +61,13 @@ class VanillaTransformer:
             q = _linear(lp["wq"], y, dtype)
             k = _linear(lp["wk"], y, dtype)
             v = _linear(lp["wv"], y, dtype)
-            split = lambda z: z.reshape(b, t, cfg.num_heads, h).transpose(0, 2, 1, 3)
-            q, k, v = split(q), split(k), split(v)
+            split = lambda z, nh: z.reshape(b, t, nh, h).transpose(0, 2, 1, 3)
+            q = split(q, cfg.num_heads)
+            k, v = split(k, cfg.kv_heads), split(v, cfg.kv_heads)
             q, k = apply_rotary(q, k, cos, sin)
+            if cfg.kv_heads != cfg.num_heads:  # grouped-query attention
+                rep = cfg.num_heads // cfg.kv_heads
+                k, v = jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1)
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(h)
             mask = jnp.triu(jnp.ones((t, t), dtype=bool), k=1)
             scores = jnp.where(mask[None, None], jnp.asarray(-10000.0, scores.dtype), scores)

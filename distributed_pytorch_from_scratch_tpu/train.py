@@ -69,6 +69,10 @@ def get_train_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("training")
     g.add_argument("--lr", type=float, default=3e-4)
+    g.add_argument("--clip_grad_norm", type=float, default=None,
+                   help="global-norm gradient clipping (torch "
+                        "clip_grad_norm_ semantics); off by default like "
+                        "the reference")
     g.add_argument("--warmup_steps", type=int, default=2000)
     g.add_argument("--max_steps", type=int, default=20000)
     g.add_argument("--log_interval", type=int, default=100)
@@ -103,6 +107,10 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--attn_dim", type=int, default=None)
     g.add_argument("--ffn_dim", type=int, default=None)
     g.add_argument("--num_heads", type=int, default=None)
+    g.add_argument("--num_kv_heads", type=int, default=None,
+                   help="grouped-query attention: K/V heads shared across "
+                        "query-head groups (llama family; default = "
+                        "num_heads, i.e. plain MHA like the reference)")
     g.add_argument("--num_layers", type=int, default=None)
     g.add_argument("--maxlen", type=int, default=None)
     g.add_argument("--remat", choices=sorted(REMAT_CHOICES),
@@ -196,6 +204,8 @@ def train(args: argparse.Namespace) -> dict:
     cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
                       ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
                       num_heads=pick(args.num_heads, preset.num_heads),
+                      num_kv_heads=pick(args.num_kv_heads,
+                                        preset.num_kv_heads),
                       num_layers=pick(args.num_layers, preset.num_layers),
                       vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
@@ -210,7 +220,8 @@ def train(args: argparse.Namespace) -> dict:
                         sequence_parallel=args.sequence_parallel,
                         remat=REMAT_CHOICES[args.remat])
     ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
-                           max_steps=args.max_steps)
+                           max_steps=args.max_steps,
+                           clip_grad_norm=args.clip_grad_norm)
 
     params = model.init(jax.random.key(args.random_seed))
     # count from the actual pytree: exact for every family (cfg.num_params()

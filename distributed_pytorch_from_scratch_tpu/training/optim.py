@@ -86,6 +86,17 @@ def onecycle_lr(cfg: OptimizerConfig, step: jax.Array) -> Tuple[jax.Array, jax.A
     return lr, beta1
 
 
+def clip_by_global_norm(grads: Any, max_norm: float) -> Any:
+    """torch `clip_grad_norm_` semantics: one L2 norm over every grad leaf,
+    scaled by max_norm/(norm + 1e-6) only when the norm exceeds max_norm."""
+    leaves = jax.tree.leaves(grads)
+    norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in leaves))
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
+                        grads)
+
+
 def adam_update(cfg: OptimizerConfig, params: Any, grads: Any,
                 state: AdamState) -> Tuple[Any, AdamState]:
     """One Adam step with the OneCycle (lr, beta1) for this step.
@@ -95,6 +106,8 @@ def adam_update(cfg: OptimizerConfig, params: Any, grads: Any,
         nu    <- b2*nu + (1-b2)*g^2
         p     <- p - lr * (mu/(1-b1^t)) / (sqrt(nu/(1-b2^t)) + eps)
     """
+    if cfg.clip_grad_norm is not None:
+        grads = clip_by_global_norm(grads, cfg.clip_grad_norm)
     step = state.step  # 0-based count of completed steps
     lr, beta1 = onecycle_lr(cfg, step)
     beta2 = cfg.betas[1]

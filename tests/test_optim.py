@@ -85,3 +85,44 @@ def test_adam_state_pytree_matches_params():
                                 params, jax.tree.map(jnp.ones_like, params), st)
     assert int(new_st.step) == 1
     assert jax.tree.structure(new_p) == jax.tree.structure(params)
+
+
+def test_clip_grad_norm_matches_torch():
+    """clip_by_global_norm == torch.nn.utils.clip_grad_norm_: one global L2
+    norm over every leaf, scale only when it exceeds max_norm."""
+    from distributed_pytorch_from_scratch_tpu.training.optim import (
+        clip_by_global_norm)
+
+    rng = np.random.RandomState(1)
+    g1 = rng.randn(8, 4).astype(np.float32) * 3.0
+    g2 = rng.randn(16).astype(np.float32) * 0.1
+
+    for max_norm in (0.5, 5.0, 1e6):  # clipped, clipped, no-op
+        pt = [torch.nn.Parameter(torch.zeros(8, 4)),
+              torch.nn.Parameter(torch.zeros(16))]
+        pt[0].grad = torch.tensor(g1.copy())
+        pt[1].grad = torch.tensor(g2.copy())
+        torch.nn.utils.clip_grad_norm_(pt, max_norm)
+
+        ours = clip_by_global_norm({"a": jnp.asarray(g1),
+                                    "b": jnp.asarray(g2)}, max_norm)
+        np.testing.assert_allclose(np.asarray(ours["a"]),
+                                   pt[0].grad.numpy(), rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(ours["b"]),
+                                   pt[1].grad.numpy(), rtol=1e-6, atol=1e-7)
+
+
+def test_clip_grad_norm_in_adam_update():
+    """OptimizerConfig.clip_grad_norm=NORM routes through adam_update: a
+    huge gradient must produce the same update as its pre-clipped version."""
+    cfg = OptimizerConfig(lr=1e-2, warmup_steps=2, max_steps=10,
+                          clip_grad_norm=1.0)
+    params = {"w": jnp.ones((4,))}
+    big = {"w": jnp.full((4,), 100.0)}
+    clipped = {"w": big["w"] * (1.0 / (jnp.linalg.norm(big["w"]) + 1e-6))}
+
+    p1, _ = adam_update(cfg, params, big, init_adam_state(params))
+    cfg_off = OptimizerConfig(lr=1e-2, warmup_steps=2, max_steps=10)
+    p2, _ = adam_update(cfg_off, params, clipped, init_adam_state(params))
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-6)
