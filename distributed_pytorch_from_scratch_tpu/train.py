@@ -41,7 +41,8 @@ from .training.metrics import (MetricsWriter, ProfilerTrace,
                                chip_peak_flops, device_memory_gib,
                                model_flops_per_step)
 from .training.optim import init_adam_state, onecycle_lr
-from .training.train_step import build_train_step, build_train_step_multi
+from .training.train_step import (build_grad_accum_step, build_train_step,
+                                  build_train_step_multi)
 from .training.zero import zero1_moment_shardings
 
 
@@ -93,6 +94,11 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "(lax.scan over a stacked megabatch): bitwise the "
                         "same training, N-fold fewer host round-trips; "
                         "logs/saves land on dispatch boundaries")
+    g.add_argument("--grad_accum", type=int, default=1,
+                   help="gradient accumulation: each optimizer step averages "
+                        "the grads of N microbatches (effective batch "
+                        "N*batch_size at one microbatch's activation "
+                        "memory); exclusive with --steps_per_dispatch > 1")
 
     g = p.add_argument_group("model")
     g.add_argument("--family", choices=["llama", "gpt2"], default="llama",
@@ -251,19 +257,26 @@ def train(args: argparse.Namespace) -> dict:
             mu=moment_sh, nu=moment_sh))
 
     spd = max(1, args.steps_per_dispatch)
+    accum = max(1, args.grad_accum)
+    if accum > 1 and spd > 1:
+        raise SystemExit("--grad_accum and --steps_per_dispatch > 1 are "
+                         "mutually exclusive")
     if spd > 1 and args.max_steps % spd != 0:
         print(f"note: --max_steps {args.max_steps} is not a multiple of "
               f"--steps_per_dispatch {spd}: the final "
               f"{args.max_steps % spd}-step tail triggers a one-time XLA "
               f"recompile (pick a divisible pair to avoid it)")
-    if spd > 1:
-        step_fn = build_train_step_multi(
-            model, mesh, ocfg, args.loss_mode, zero1=args.zero1,
-            moment_shardings=moment_sh if args.zero1 else None)
+    builder_kwargs = dict(zero1=args.zero1,
+                          moment_shardings=moment_sh if args.zero1 else None)
+    if accum > 1:
+        step_fn = build_grad_accum_step(model, mesh, ocfg, args.loss_mode,
+                                        **builder_kwargs)
+    elif spd > 1:
+        step_fn = build_train_step_multi(model, mesh, ocfg, args.loss_mode,
+                                         **builder_kwargs)
     else:
-        step_fn = build_train_step(
-            model, mesh, ocfg, args.loss_mode, zero1=args.zero1,
-            moment_shardings=moment_sh if args.zero1 else None)
+        step_fn = build_train_step(model, mesh, ocfg, args.loss_mode,
+                                   **builder_kwargs)
     writer = MetricsWriter(os.path.join(args.save_dir, "logs"))
     # profile a window shortly after start so compile+layout churn is over
     profiler = ProfilerTrace(os.path.join(args.save_dir, "logs"),
@@ -272,17 +285,19 @@ def train(args: argparse.Namespace) -> dict:
     flops_step = model_flops_per_step(cfg, args.batch_size, maxlen)
     peak_flops = chip_peak_flops() * mesh_cfg.world_size
 
-    steps_per_epoch = len(dataloader)
+    # with accumulation one optimizer step consumes `accum` batches
+    steps_per_epoch = len(dataloader) // accum
     if steps_per_epoch == 0:
         raise SystemExit(
-            f"dataset has {len(dataloader.dataset)} sequences but batch_size "
-            f"is {args.batch_size} (drop_last): zero batches per epoch — "
-            f"reduce --batch_size")
+            f"dataset has {len(dataloader.dataset)} sequences but one "
+            f"optimizer step needs {args.batch_size * accum} "
+            f"(batch_size x grad_accum, drop_last): zero steps per epoch — "
+            f"reduce --batch_size/--grad_accum")
     max_epoch = math.ceil(args.max_steps / steps_per_epoch)
     # resume continues the data stream too: same seeded per-epoch order,
     # skipping the batches already consumed
     start_epoch = start_step // steps_per_epoch
-    skip_batches = start_step % steps_per_epoch
+    skip_batches = (start_step % steps_per_epoch) * accum
     # accumulate the loss on-device; a float() sync every step would
     # serialize host dispatch with device execution
     accum_loss, n = jnp.zeros((), jnp.float32), start_step
@@ -341,27 +356,28 @@ def train(args: argparse.Namespace) -> dict:
                 # fixed, so nothing forces a flush there — and shrinks near
                 # max_steps so the run ends exactly on it.
                 batch_buf.append(batch)
-                want = min(spd, args.max_steps - n)
+                want = accum if accum > 1 else min(spd, args.max_steps - n)
                 if len(batch_buf) < want:
                     continue
                 prev_n = n
                 if args.profile_steps:
                     profiler.maybe_start(n)
-                if spd > 1:
+                if accum > 1 or spd > 1:
                     stacked = {key: jnp.asarray(np.stack(
                         [b[key] for b in batch_buf]))
                         for key in ("input_ids", "target_ids", "position_ids")}
                     params, opt_state, losses = step_fn(
                         params, opt_state, stacked["input_ids"],
                         stacked["target_ids"], stacked["position_ids"])
-                    loss = jnp.sum(losses)
+                    # accumulation: `losses` is already the one step's mean
+                    loss = losses if accum > 1 else jnp.sum(losses)
                 else:
                     params, opt_state, loss = step_fn(
                         params, opt_state,
                         jnp.asarray(batch_buf[0]["input_ids"]),
                         jnp.asarray(batch_buf[0]["target_ids"]),
                         jnp.asarray(batch_buf[0]["position_ids"]))
-                n += len(batch_buf)
+                n += 1 if accum > 1 else len(batch_buf)
                 tokens_since += sum(b["input_ids"].size for b in batch_buf)
                 steps_since += len(batch_buf)
                 batch_buf = []
@@ -388,6 +404,14 @@ def train(args: argparse.Namespace) -> dict:
                 if n >= args.max_steps:
                     done = True
                     break
+            if accum > 1 and batch_buf:
+                # drop the epoch's partial accumulation group (drop_last
+                # semantics at the optimizer-step level): every epoch then
+                # performs exactly steps_per_epoch steps, which the resume
+                # math (start_epoch/skip_batches above) relies on — a
+                # carried partial group would shift every later epoch's
+                # batch<->step mapping
+                batch_buf = []
             print(f"epoch {epoch + 1}/{max_epoch} finished")
             if done:
                 break

@@ -105,5 +105,44 @@ def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
                            P(None))
 
 
+def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
+                          loss_mode: str = "vocab_parallel",
+                          zero1: bool = False, moment_shardings=None):
+    """Gradient accumulation: ONE optimizer step from the MEAN of the
+    microbatch gradients.
+
+    (params, opt_state, input_ids(A,B,T), target_ids(A,B,T),
+     position_ids(A,B,T)) -> (params, opt_state, mean_loss)
+
+    Semantics are torch-DDP-style mean-of-means: each microbatch's masked
+    token-mean CE and its gradient get equal weight regardless of how many
+    valid tokens each holds (identical to a single A*B batch whenever the
+    valid counts match). Peak activation memory stays that of ONE microbatch
+    — the scan carries only the f32 grad sum — so effective batch scales
+    without scaling HBM. The reference has no accumulation (SURVEY
+    non-goals); this is the TPU-native extension of its loop.
+    """
+    grad_fn = jax.value_and_grad(model.make_loss(mesh, mode=loss_mode))
+
+    def step(params, opt_state: AdamState, input_ids, target_ids,
+             position_ids):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+
+        def body(acc, batch):
+            loss_sum, g_sum = acc
+            loss, g = grad_fn(params, *batch)
+            return (loss_sum + loss, jax.tree.map(jnp.add, g_sum, g)), None
+
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros),
+            (input_ids, target_ids, position_ids))
+        a = input_ids.shape[0]
+        grads = jax.tree.map(lambda x: x / a, g_sum)
+        params, opt_state = adam_update(ocfg, params, grads, opt_state)
+        return params, opt_state, loss_sum / a
+
+    return _jit_with_zero1(step, model, mesh, zero1, moment_shardings, P())
+
+
 def build_eval_loss(model: Transformer, mesh, loss_mode: str = "vocab_parallel"):
     return jax.jit(model.make_loss(mesh, mode=loss_mode))

@@ -1,0 +1,86 @@
+"""Gradient accumulation: one optimizer step from the mean of A microbatch
+gradients == one step on the concatenated A*B batch whenever the microbatch
+valid-token counts match (mean-of-means == global mean then). Also covers
+the CLI integration and the mutual exclusion with --steps_per_dispatch."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
+                                                  Transformer, make_mesh)
+from distributed_pytorch_from_scratch_tpu.config import OptimizerConfig
+from distributed_pytorch_from_scratch_tpu.training.optim import (
+    init_adam_state)
+from distributed_pytorch_from_scratch_tpu.training.train_step import (
+    build_grad_accum_step, build_train_step)
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                  vocab_size=64, maxlen=16)
+
+
+@pytest.mark.parametrize("dp,tp", [(1, 1), (2, 2)])
+def test_accum_matches_concatenated_batch(dp, tp):
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    model = Transformer(CFG, tp_size=tp)
+    ocfg = OptimizerConfig(lr=1e-3, warmup_steps=2, max_steps=8)
+    sh = model.shardings(mesh)
+    A, B, T = 4, 4, 16
+    # fully-valid targets: every microbatch then weighs B*T tokens, so
+    # mean-of-means equals the concatenated batch's global mean exactly
+    ids = jax.random.randint(jax.random.key(1), (A, B, T), 0, CFG.vocab_size)
+    tgt = jnp.roll(ids, -1, axis=2)
+    pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, None, :], (A, B, 1))
+
+    p1 = jax.device_put(model.init(jax.random.key(0)), sh)
+    o1 = init_adam_state(p1)
+    accum = build_grad_accum_step(model, mesh, ocfg)
+    p1, o1, l1 = accum(p1, o1, ids, tgt, pos)
+
+    p2 = jax.device_put(model.init(jax.random.key(0)), sh)
+    o2 = init_adam_state(p2)
+    step = build_train_step(model, mesh, ocfg)
+    big = lambda x: x.reshape(A * B, T)
+    p2, o2, l2 = step(p2, o2, big(ids), big(tgt), big(pos))
+
+    np.testing.assert_allclose(float(l1), float(l2), atol=1e-6)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-6), p1, p2)
+    assert int(o1.step) == int(o2.step) == 1
+
+
+def test_cli_grad_accum(tmp_path):
+    import json
+
+    from distributed_pytorch_from_scratch_tpu import train as train_mod
+    from distributed_pytorch_from_scratch_tpu.data.tokenizer import (
+        pre_tokenize, train_bpe)
+    from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+        list_checkpoints)
+
+    texts = ["the king rode out at dawn with his men",
+             "a quiet morning on the river bank",
+             "she sold sea shells by the sea shore",
+             "to be or not to be that is the question"] * 4
+    tj = tmp_path / "texts.json"
+    json.dump({"train": texts, "validation": texts[:2]}, open(tj, "w"))
+    train_bpe(str(tj), str(tmp_path / "tok.json"), vocab_size=270)
+    pre_tokenize(str(tj), str(tmp_path / "tokens.json"),
+                 str(tmp_path / "tok.json"))
+
+    base = ["--data_path", str(tmp_path / "tokens.json"),
+            "--save_dir", str(tmp_path / "ck"),
+            "--attn_dim", "32", "--ffn_dim", "64", "--num_heads", "4",
+            "--num_layers", "2", "--maxlen", "32", "--batch_size", "2",
+            "--max_steps", "4", "--save_interval", "2",
+            "--log_interval", "1", "--warmup_steps", "2"]
+    r = train_mod.train(train_mod.get_train_args(base + ["--grad_accum", "2"]))
+    # 4 optimizer steps, each from 2 microbatches (16 sequences / 2 per
+    # microbatch / 2 accum = 4 steps/epoch: exactly one epoch)
+    assert r["steps"] == 4 and np.isfinite(r["avg_loss"])
+    assert [it for it, _ in list_checkpoints(str(tmp_path / "ck"))] == [2, 4]
+
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        train_mod.train(train_mod.get_train_args(
+            base + ["--grad_accum", "2", "--steps_per_dispatch", "2"]))
