@@ -12,6 +12,7 @@ from .config import (
     BOS_TOKEN, EOS_TOKEN, UNK_TOKEN, IGNORE_INDEX,
     EvalConfig, MeshConfig, ModelConfig, OptimizerConfig, TrainConfig,
 )
+from .models.gpt2 import GPT2Transformer
 from .models.transformer import Transformer
-from .models.vanilla import VanillaTransformer
+from .models.vanilla import VanillaGPT2, VanillaTransformer
 from .runtime.mesh import make_mesh, tp_mesh, single_device_mesh

@@ -15,9 +15,11 @@ reference non-goal). This module is the TPU-native upgrade, two levels deep:
    to dwarf the 45M model's ~1.7 ms of per-token compute); the fused loop
    runs at device speed and returns once per prompt.
 
-Layout: caches are (num_layers, b, local_heads, buf_len, head_dim), sharded
-over 'tp' on the heads dim — the same head partitioning as training, so the
-same checkpoint params work unchanged. Decode is TP-only (dp=cp=1), like the
+Layout: caches are (num_layers, b, local_KV_heads, buf_len, head_dim),
+sharded over 'tp' on the heads dim — the same head partitioning as training,
+so the same checkpoint params work unchanged; under grouped-query attention
+the caches are num_heads/num_kv_heads x smaller than the query-head count
+(the GQA decode memory win). Decode is TP-only (dp=cp=1), like the
 reference's eval (`test.py` runs the TP mesh it trained with).
 """
 
@@ -40,12 +42,14 @@ Params = Dict[str, Any]
 
 
 def _qkv(model: Transformer, lp: Params, y: jax.Array, dtype):
-    """Project y (b, t, d) -> per-head q, k, v (b, local_heads, t, hd).
+    """Project y (b, t, d) -> q (b, local_heads, t, hd) and k, v at
+    (b, local_KV_heads, t, hd).
 
-    Under grouped-query attention the kv heads are repeated to the query
-    head count here, so the caches below store group-expanded K/V — correct
-    for any num_kv_heads; keeping the caches at kv_heads (the GQA memory
-    win) is a future optimisation of this decoder."""
+    Under grouped-query attention k/v stay at the (smaller) kv-head count —
+    the caches then hold kv_heads entries, which is the GQA decode memory
+    win (num_heads/num_kv_heads x smaller KV cache). Query head i reads kv
+    head i // group, matching training's `jnp.repeat(k, group, axis=1)`
+    layout (models/transformer.py)."""
     m = model._mods
     b, t, _ = y.shape
     h = model.cfg.head_dim
@@ -53,11 +57,16 @@ def _qkv(model: Transformer, lp: Params, y: jax.Array, dtype):
     q = split(m["wq"].apply(lp["wq"], y, dtype), model.num_local_heads)
     k = split(m["wk"].apply(lp["wk"], y, dtype), model.num_local_kv_heads)
     v = split(m["wv"].apply(lp["wv"], y, dtype), model.num_local_kv_heads)
+    return q, k, v
+
+
+def _expand_groups(model: Transformer, k: jax.Array, v: jax.Array):
+    """Repeat kv heads to the query-head count (dense-attention consumers)."""
     group = model.num_local_heads // model.num_local_kv_heads
     if group > 1:
         k = jnp.repeat(k, group, axis=1)
         v = jnp.repeat(v, group, axis=1)
-    return q, k, v
+    return k, v
 
 
 def _finish_block(model: Transformer, lp: Params, x: jax.Array,
@@ -105,9 +114,10 @@ def _prefill(model: Transformer, params: Params, buf: jax.Array,
         y = model._mods["norm1"].apply(lp["norm1"], x)
         q, k, v = _qkv(model, lp, y, dtype)
         q, k = apply_rotary(q, k, cos, sin)
-        o = causal_attention(q, k, v, impl=model.attn_impl)
+        ke, ve = _expand_groups(model, k, v)
+        o = causal_attention(q, ke, ve, impl=model.attn_impl)
         x = _finish_block(model, lp, x, o, dtype)
-        return x, (k, v)
+        return x, (k, v)  # caches stay at kv_heads (see _qkv)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     last = jnp.take_along_axis(
@@ -130,18 +140,26 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
     def body(x, layer_in):
         lp, k_cache, v_cache = layer_in
         y = model._mods["norm1"].apply(lp["norm1"], x)
-        q, k, v = _qkv(model, lp, y, dtype)              # (b, h, 1, hd)
+        q, k, v = _qkv(model, lp, y, dtype)   # q: (b, h, 1, hd); kv: kvh
         q, k = apply_rotary(q, k, cos, sin)
         k_cache = lax.dynamic_update_slice_in_dim(
             k_cache, k.astype(k_cache.dtype), cur, axis=2)
         v_cache = lax.dynamic_update_slice_in_dim(
             v_cache, v.astype(v_cache.dtype), cur, axis=2)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache,
+        # grouped attention against the kv-head caches: query head
+        # kv_idx*g + g_idx reads kv head kv_idx (g == 1 reduces to plain
+        # MHA — the reshapes are identities)
+        kvh = model.num_local_kv_heads
+        g = model.num_local_heads // kvh
+        hd = model.cfg.head_dim
+        qg = q[:, :, 0, :].reshape(b, kvh, g, hd)
+        s = jnp.einsum("bkgd,bktd->bkgt", qg, k_cache,
                        preferred_element_type=jnp.float32)
-        s = s / jnp.sqrt(jnp.asarray(model.cfg.head_dim, jnp.float32))
+        s = s / jnp.sqrt(jnp.asarray(hd, jnp.float32))
         s = jnp.where(visible, s, MASK_VALUE)
         p = jax.nn.softmax(s, axis=-1).astype(dtype)
-        o = jnp.einsum("bhqk,bhkd->bhqd", p, v_cache)
+        o = jnp.einsum("bkgt,bktd->bkgd", p, v_cache)
+        o = o.reshape(b, kvh * g, hd)[:, :, None, :]   # (b, h, 1, hd)
         x = _finish_block(model, lp, x, o, dtype)
         return x, (k_cache, v_cache)
 

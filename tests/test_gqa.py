@@ -83,6 +83,35 @@ def test_gqa_kv_decode_matches_forward_argmax():
     assert gen == ids[len(prompt):], (gen, ids[len(prompt):])
 
 
+def test_gqa_kv_cache_stays_at_kv_heads():
+    """The decode caches hold num_kv_heads entries — the GQA memory win —
+    not the group-expanded query-head count."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_pytorch_from_scratch_tpu.models import decode as dec
+    from distributed_pytorch_from_scratch_tpu.config import resolve_dtype
+    from distributed_pytorch_from_scratch_tpu.ops.rope import rope_tables
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = Transformer(CFG, tp_size=2)
+    params = model.init(jax.random.key(0))
+    dtype = resolve_dtype(CFG.compute_dtype)
+    buf = jnp.zeros((1, 8), jnp.int32)
+
+    def shard_fn(params, buf):
+        cos_t, sin_t = rope_tables(CFG.maxlen, CFG.head_dim, CFG.rope_theta)
+        ks, vs, _ = dec._prefill(model, params, buf,
+                                 jnp.asarray([4]), cos_t, sin_t, dtype)
+        return ks.shape[2], vs.shape[2]  # head axis of (L, b, heads, t, hd)
+
+    with mesh:
+        kh, vh = jax.shard_map(shard_fn, mesh=mesh,
+                               in_specs=(model.specs(), P(None, None)),
+                               out_specs=P())(params, buf)
+    assert kh == vh == CFG.kv_heads // 2  # local kv heads, NOT local q heads
+    assert CFG.kv_heads // 2 < CFG.num_heads // 2
+
+
 def test_gqa_validation():
     with pytest.raises(ValueError, match="multiple"):
         Transformer(ModelConfig(num_heads=8, num_kv_heads=3), tp_size=1)
