@@ -59,7 +59,7 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    default="contiguous",
                    help="zigzag: each cp shard gets an equally early+late "
                         "pair of sequence sub-chunks, balancing causal ring "
-                        "work ~2x (ring impl only; needs maxlen % (2*cp)==0)")
+                        "work ~2x (ring impl only; needs maxlen %% (2*cp)==0)")
     g.add_argument("--sequence_parallel", action="store_true",
                    help="Megatron-style SP: shard inter-block activations "
                         "over the tp axis (reduce-scatter/all-gather instead "
