@@ -60,6 +60,15 @@ class ModelConfig:
     # and the loss always stay float32 (the reference's autocast semantics:
     # `/root/reference/train.py:99-104`).
     compute_dtype: str = "float32"
+    # Mixture-of-Experts: 0 = dense SwiGLU FFN (the reference's only FFN,
+    # `/root/reference/models/model.py:81-95`); > 0 swaps every layer's FFN
+    # for a top-k routed MoE (parallel/moe.py) with experts sharded over the
+    # mesh axis 'ep'. No reference counterpart (SURVEY §2.4 "EP ❌").
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_coef: float = 0.01   # load-balance loss weight (Switch: 0.01)
+    moe_z_coef: float = 1e-3     # router z-loss weight (ST-MoE: 1e-3)
 
     @property
     def head_dim(self) -> int:
@@ -89,7 +98,10 @@ class ModelConfig:
         d, f, v, L = self.attn_dim, self.ffn_dim, self.vocab_size, self.num_layers
         kd = self.kv_dim
         attn = 2 * d * d + 2 * d * kd + 2 * d + 2 * kd  # wq/wo + wk/wv (+ biases)
-        ffn = 3 * d * f + 2 * f + d              # gate/up/down weights + biases
+        if self.num_experts:
+            ffn = self.num_experts * 3 * d * f + d * self.num_experts  # experts + router
+        else:
+            ffn = 3 * d * f + 2 * f + d          # gate/up/down weights + biases
         norms = 2 * d
         return v * d + L * (attn + ffn + norms) + d + v * d + v  # emb + layers + final norm + lm_head
 
@@ -121,22 +133,27 @@ def model_preset(name: str, **overrides) -> ModelConfig:
 
 @dataclass(frozen=True)
 class MeshConfig:
-    """3-D device mesh: ('dp', 'cp', 'tp').
+    """5-D device mesh: ('dp', 'pp', 'cp', 'ep', 'tp').
 
     The reference supports exactly one axis (TP == world size, asserted at
     `/root/reference/process_manager.py:13`). We design for >=2 axes from day
     one per BASELINE.json config 5 (TPxDP 4x2), plus a context-parallel axis
-    'cp' for long sequences (ring attention / Ulysses — absent from the
-    reference, SURVEY §2.4) that defaults to size 1.
+    'cp' for long sequences (ring attention / Ulysses), a pipeline axis 'pp'
+    (stage-sharded layer stack), and an expert axis 'ep' (MoE expert
+    sharding; a pure extra data axis for dense compute) — all absent from
+    the reference (SURVEY §2.4) and all defaulting to size 1, in which case
+    the mesh degenerates to the reference-parity ('dp', 'tp') shape.
     """
 
     dp: int = 1
     tp: int = 1
     cp: int = 1
+    ep: int = 1
+    pp: int = 1
 
     @property
     def world_size(self) -> int:
-        return self.dp * self.cp * self.tp
+        return self.dp * self.pp * self.cp * self.ep * self.tp
 
 
 @dataclass(frozen=True)

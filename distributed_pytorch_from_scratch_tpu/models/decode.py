@@ -77,6 +77,14 @@ def _finish_block(model: Transformer, lp: Params, x: jax.Array,
     o = o.transpose(0, 2, 1, 3).reshape(b, t, model.num_local_heads * model.cfg.head_dim)
     x = x + m["wo"].apply(lp["wo"], o, dtype)
     y = m["norm2"].apply(lp["norm2"], x)
+    if model.is_moe:
+        ff, _ = m["moe"].apply(lp["moe"], y, dtype)  # aux unused at decode
+        # Decode replicates the batch over 'ep' (in_specs P(None, None))
+        # while expert weights stay ep-sharded, so every ep shard computes
+        # the same ff values under an ep-varying vma tag. pmean averages
+        # the identical copies: value-identity, clears the tag so the scan
+        # carry and the P(None, None) out_specs stay ep-invariant.
+        return x + lax.pmean(ff, "ep")
     g = m["gate_proj"].apply(lp["gate_proj"], y, dtype)
     u = m["up_proj"].apply(lp["up_proj"], y, dtype)
     return x + m["down_proj"].apply(lp["down_proj"], jax.nn.silu(g) * u, dtype)

@@ -69,6 +69,7 @@ class GPT2Transformer:
     cp_size: int = 1
     cp_layout: str = "contiguous"
     sequence_parallel: bool = False
+    pp_size: int = 1
 
     def __post_init__(self):
         cfg, tp = self.cfg, self.tp_size
@@ -217,6 +218,12 @@ class GPT2Transformer:
         return logits
 
     # ---- everything else is the shared machinery (see module docstring) ----
+
+    is_moe = False  # dense family; loss_shard consults this
+
+    def _forward_with_aux(self, params: Params, input_ids: jax.Array,
+                          position_ids: jax.Array):
+        return self.forward_shard(params, input_ids, position_ids), None
 
     _zigzag = Transformer._zigzag
     loss_shard = Transformer.loss_shard

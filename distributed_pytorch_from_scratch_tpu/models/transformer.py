@@ -47,6 +47,7 @@ from ..ops.ring_attention import ring_attention, ulysses_attention
 from ..ops.rope import apply_rotary, rope_tables
 from ..parallel.embedding import VocabParallelEmbedding
 from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
+from ..parallel.moe import MoEFFN, aux_losses
 from ..parallel.norm import RMSNorm
 from ..runtime.prng import fold
 
@@ -83,6 +84,19 @@ class Transformer:
     cfg: ModelConfig
     tp_size: int = 1
     attn_impl: str = "auto"  # flash kernel on TPU, XLA path on CPU
+    # Expert parallelism (with cfg.num_experts > 0): experts are sharded
+    # over the mesh axis 'ep', which doubles as an extra data axis for the
+    # dense sublayers (the batch shards over dp x ep). parallel/moe.py.
+    ep_size: int = 1
+    # Pipeline parallelism over the mesh axis 'pp': the stacked layer dim is
+    # sharded (each stage owns num_layers/pp layers) and microbatches flow
+    # through a GPipe schedule built from ONE lax.scan over pipeline steps
+    # with a ppermute between stages. JAX autodiff transposes the schedule
+    # into the backward pipeline (reverse ppermute, reverse time) for free.
+    # No reference counterpart (SURVEY §2.4 "PP ❌"). Bubble fraction is
+    # (pp-1)/(microbatches+pp-1); raise pp_microbatches to amortise it.
+    pp_size: int = 1
+    pp_microbatches: int = 0  # 0 -> pp_size (the minimum that fills the pipe)
     # Context parallelism: shard the sequence dim over the mesh axis 'cp'
     # (absent from the reference — SURVEY §5.7 documents it has no
     # long-context story at all). cp_impl: 'ring' rotates KV chunks around
@@ -155,6 +169,29 @@ class Transformer:
         if self.cp_layout == "zigzag" and self.cp_impl != "ring":
             raise ValueError("cp_layout='zigzag' requires cp_impl='ring' "
                              "(Ulysses assumes rank-order contiguous chunks)")
+        if cfg.num_experts:
+            if self.sequence_parallel:
+                raise ValueError(
+                    "sequence_parallel + MoE is not supported: the router "
+                    "needs full tokens on every tp shard (gather first)")
+        elif self.ep_size > 1:
+            raise ValueError("ep_size > 1 requires cfg.num_experts > 0 "
+                             "(a dense model has nothing to shard over 'ep'; "
+                             "use dp for a pure data axis)")
+        if self.pp_size > 1:
+            if cfg.num_layers % self.pp_size != 0:
+                raise ValueError(
+                    f"num_layers {cfg.num_layers} not divisible by pp_size "
+                    f"{self.pp_size} (stages hold equal layer counts)")
+            if cfg.num_experts:
+                raise ValueError("pp + MoE is not supported yet (the "
+                                 "pipeline does not carry router aux stats)")
+            if self.sequence_parallel:
+                raise ValueError("pp + sequence_parallel is not supported")
+        if self.pp_microbatches and self.pp_microbatches < self.pp_size:
+            raise ValueError(
+                f"pp_microbatches {self.pp_microbatches} < pp_size "
+                f"{self.pp_size} would leave permanent pipeline bubbles")
 
     # ---- sub-module definitions (static, cheap to rebuild) ----
 
@@ -180,21 +217,34 @@ class Transformer:
     def embedding(self) -> VocabParallelEmbedding:
         return VocabParallelEmbedding(self.cfg.vocab_size, self.d, tp_size=self.tp_size)
 
+    @property
+    def is_moe(self) -> bool:
+        return self.cfg.num_experts > 0
+
     @functools.cached_property
     def _mods(self) -> Dict[str, Any]:
         d, f = self.d, self.cfg.ffn_dim
         kd = self.cfg.kv_dim  # < d under grouped-query attention
-        return {
+        mods = {
             "wq": ColumnParallelLinear(d, d, gather_output=False),
             "wk": ColumnParallelLinear(d, kd, gather_output=False),
             "wv": ColumnParallelLinear(d, kd, gather_output=False),
             "wo": RowParallelLinear(d, d, split_input=False),
-            "gate_proj": ColumnParallelLinear(d, f, gather_output=False),
-            "up_proj": ColumnParallelLinear(d, f, gather_output=False),
-            "down_proj": RowParallelLinear(f, d, split_input=False),
             "norm1": RMSNorm(d),
             "norm2": RMSNorm(d),
         }
+        if self.is_moe:
+            mods["moe"] = MoEFFN(
+                d, f, self.cfg.num_experts, top_k=self.cfg.moe_top_k,
+                capacity_factor=self.cfg.moe_capacity_factor,
+                ep_size=self.ep_size, tp_size=self.tp_size)
+        else:
+            mods.update({
+                "gate_proj": ColumnParallelLinear(d, f, gather_output=False),
+                "up_proj": ColumnParallelLinear(d, f, gather_output=False),
+                "down_proj": RowParallelLinear(f, d, split_input=False),
+            })
+        return mods
 
     @functools.cached_property
     def final_norm(self) -> RMSNorm:
@@ -237,9 +287,12 @@ class Transformer:
 
     def specs(self) -> Params:
         """PartitionSpec pytree matching `init`'s structure."""
+        lead = "pp" if self.pp_size > 1 else None
+
         def stack(spec_dict: Params) -> Params:
-            # prepend None for the stacked num_layers axis
-            return jax.tree.map(lambda s: P(None, *s), spec_dict,
+            # stacked num_layers axis: sharded over 'pp' when pipelining
+            # (each stage owns its num_layers/pp slice), else unsharded
+            return jax.tree.map(lambda s: P(lead, *s), spec_dict,
                                 is_leaf=lambda x: isinstance(x, P))
         return {
             "embedding": self.embedding.specs(),
@@ -305,7 +358,11 @@ class Transformer:
                               output_layout=out_layout)
 
         # FFN sublayer: x + down(silu(gate(x)) * up(x))   (model.py:94-95,120)
+        # — or, with cfg.num_experts > 0, x + MoE(norm2(x)) (parallel/moe.py)
         y = maybe_gather(m["norm2"].apply(layer_params["norm2"], x))
+        if self.is_moe:
+            ff, aux = m["moe"].apply(layer_params["moe"], y, dtype)
+            return x + ff, aux
         g = m["gate_proj"].apply(layer_params["gate_proj"], y, dtype,
                                  input_layout=in_layout)
         u = m["up_proj"].apply(layer_params["up_proj"], y, dtype,
@@ -313,7 +370,7 @@ class Transformer:
         x = x + m["down_proj"].apply(layer_params["down_proj"],
                                      jax.nn.silu(g) * u, dtype,
                                      output_layout=out_layout)
-        return x
+        return x, None
 
     def forward_shard(self, params: Params, input_ids: jax.Array,
                       position_ids: jax.Array) -> jax.Array:
@@ -322,6 +379,14 @@ class Transformer:
         Runs per-shard inside shard_map. The caller chooses whether to stitch
         (out_spec P('dp', None, 'tp')) or explicitly `gather_from` the result.
         """
+        logits, _ = self._forward_with_aux(params, input_ids, position_ids)
+        return logits
+
+    def _forward_with_aux(self, params: Params, input_ids: jax.Array,
+                          position_ids: jax.Array):
+        """forward_shard + the MoE aux-stat sums (None for dense models),
+        summed over layers but still LOCAL to this shard — loss_shard psums
+        them over the batch axes before forming the aux losses."""
         dtype = resolve_dtype(self.cfg.compute_dtype)
         sp = self.sequence_parallel
         if sp and input_ids.shape[1] % self.tp_size != 0:
@@ -342,11 +407,19 @@ class Transformer:
 
         layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(5,))
 
-        def body(carry, layer_params):
-            return layer_fn(carry, layer_params, cos, sin, position_ids,
-                            dtype), None
+        if self.pp_size > 1:
+            x = self._pipeline_layers(layer_fn, x, params["layers"], cos,
+                                      sin, position_ids, dtype)
+            aux = None
+        else:
+            def body(carry, layer_params):
+                return layer_fn(carry, layer_params, cos, sin, position_ids,
+                                dtype)
 
-        x, _ = lax.scan(body, x, params["layers"])
+            x, auxs = lax.scan(body, x, params["layers"])
+            # auxs: None for dense; for MoE a dict of (L,...) stacked sums
+            aux = (jax.tree.map(lambda a: jnp.sum(a, axis=0), auxs)
+                   if self.is_moe else None)
         x = self.final_norm.apply(params["norm"], x)
         logits = self.lm_head.apply(
             params["lm_head"], x, dtype,
@@ -359,21 +432,91 @@ class Transformer:
             col = start + jnp.arange(local_v)
             logits = jnp.where(col[None, None, :] < self.cfg.vocab_size,
                                logits, jnp.asarray(NEG_INF, logits.dtype))
-        return logits
+        return logits, aux
+
+    def _pipeline_layers(self, layer_fn, x: jax.Array, layers: Params,
+                         cos: jax.Array, sin: jax.Array, pos: jax.Array,
+                         dtype) -> jax.Array:
+        """GPipe microbatch pipeline over the 'pp' mesh axis.
+
+        `layers` arrive ALREADY sliced by shard_map to this stage's
+        (num_layers/pp, ...) block (specs() shards the stacked layer dim
+        over 'pp'). The schedule is one lax.scan over M + pp - 1 pipeline
+        steps; at step s, stage p runs microbatch s - p through its local
+        layers and ppermutes the activation to stage p + 1. Autodiff
+        transposes this into the reverse-time backward pipeline. Bubble
+        steps compute a clamped microbatch whose output is discarded.
+
+        Returns the final-layer activation for the FULL local batch,
+        replicated over 'pp' (psum of the last stage's collected outputs) —
+        so the caller's norm/lm_head code is pipeline-oblivious. The loss
+        masks its sums to the last stage and psums over 'pp' so replicated
+        params do not double-count cotangents (see loss_shard).
+        """
+        pp = self.pp_size
+        M = self.pp_microbatches or pp
+        b, t, d = x.shape
+        if b % M != 0:
+            raise ValueError(f"local batch {b} not divisible by "
+                             f"pp_microbatches {M}")
+        mb = b // M
+        stage = lax.axis_index("pp")
+        last = pp - 1
+
+        # (M, mb, ...) microbatch views; cos/sin/pos are replicated over pp
+        # so every stage can index its current microbatch locally.
+        xs = x.reshape(M, mb, t, d)
+        cos_m = cos.reshape(M, mb, *cos.shape[1:])
+        sin_m = sin.reshape(M, mb, *sin.shape[1:])
+        pos_m = pos.reshape(M, mb, *pos.shape[1:])
+
+        def local_layers(z, c, s_, p_):
+            def body(carry, lp):
+                y, _ = layer_fn(carry, lp, c, s_, p_, dtype)
+                return y, None
+            z, _ = lax.scan(body, z, layers)
+            return z
+
+        def pipe_step(carry, s):
+            # which microbatch this stage works on (clamped during bubbles)
+            m = jnp.clip(s - stage, 0, M - 1)
+            inject = lax.dynamic_index_in_dim(xs, jnp.clip(s, 0, M - 1), 0,
+                                              keepdims=False)
+            z = jnp.where(stage == 0, inject, carry)
+            take = lambda a: lax.dynamic_index_in_dim(a, m, 0,
+                                                      keepdims=False)
+            y = local_layers(z, take(cos_m), take(sin_m), take(pos_m))
+            out = jnp.where(stage == last, y, jnp.zeros_like(y))
+            # stage p -> p + 1; the wrap to stage 0 is overwritten by inject
+            n = pp
+            y_send = lax.ppermute(y, "pp",
+                                  [(i, (i + 1) % n) for i in range(n)])
+            return y_send, out
+
+        # vma: the carried activation varies over 'pp' (stage-dependent) and
+        # over the batch axes (x is batch-sharded), like y itself.
+        carry0 = jnp.zeros((mb, t, d), x.dtype)
+        carry0 = lax.pvary(carry0, ("pp", "dp", "ep", "cp"))
+        _, outs = lax.scan(pipe_step, carry0,
+                           jnp.arange(M + pp - 1, dtype=jnp.int32))
+        # outs[last + m] is microbatch m off the last stage; psum broadcasts
+        # it to every stage (zeros elsewhere) so downstream code is SPMD.
+        x_final = outs[last:].reshape(b, t, d)
+        return lax.psum(x_final, "pp")
 
     # ---- losses (per-shard, inside shard_map) ----
 
     def loss_shard(self, params: Params, input_ids: jax.Array,
                    target_ids: jax.Array, position_ids: jax.Array,
                    mode: str = "vocab_parallel",
-                   batch_axes: Tuple[str, ...] = ("dp", "cp")) -> jax.Array:
+                   batch_axes: Tuple[str, ...] = ("dp", "ep", "cp")) -> jax.Array:
         """Mean cross-entropy over non-ignored tokens, global over the mesh.
 
         f32 loss with ignore-index masking, matching the reference's
         `F.cross_entropy(logits.float(), ..., ignore_index=-1, 'mean')`
         (`/root/reference/train.py:101-104`).
         """
-        logits = self.forward_shard(params, input_ids, position_ids)
+        logits, aux = self._forward_with_aux(params, input_ids, position_ids)
         logits = logits.astype(jnp.float32)
         valid = target_ids != IGNORE_INDEX
         tgt = jnp.where(valid, target_ids, 0)
@@ -411,9 +554,31 @@ class Transformer:
 
         loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
         count = jnp.sum(valid.astype(jnp.float32))
+        if self.pp_size > 1:
+            # Every stage computes the same CE from the psum-broadcast
+            # x_final (_pipeline_layers), so count it ONCE: mask to the last
+            # stage and psum over 'pp' as well. This also zeroes the CE
+            # cotangent on the other stages — without it, shard_map's
+            # transpose would psum pp_size identical lm_head/embedding
+            # cotangents (they are replicated over 'pp') and scale their
+            # gradients by pp_size.
+            is_last = (lax.axis_index("pp") == self.pp_size - 1)
+            is_last = is_last.astype(jnp.float32)
+            loss_sum = loss_sum * is_last
+            count = count * is_last
+            batch_axes = tuple(batch_axes) + ("pp",)
         loss_sum = lax.psum(loss_sum, batch_axes)
         count = lax.psum(count, batch_axes)
-        return loss_sum / jnp.maximum(count, 1.0)
+        loss = loss_sum / jnp.maximum(count, 1.0)
+        if self.is_moe:
+            # Globally-summed router stats -> sharding-invariant aux losses
+            # (load balance + z), added with their Switch/ST-MoE weights.
+            aux_g = jax.tree.map(lambda a: lax.psum(a, batch_axes), aux)
+            lb, z = aux_losses(aux_g, self.cfg.num_experts,
+                               self.cfg.moe_top_k)
+            loss = (loss + self.cfg.moe_aux_coef * lb
+                    + self.cfg.moe_z_coef * z)
+        return loss
 
     # ---- global (jitted) entry points ----
 
@@ -432,8 +597,9 @@ class Transformer:
 
         fwd = jax.shard_map(
             self.forward_shard, mesh=mesh,
-            in_specs=(self.specs(), P("dp", "cp"), P("dp", "cp")),
-            out_specs=P("dp", "cp", "tp"),
+            in_specs=(self.specs(), P(("dp", "ep"), "cp"),
+                      P(("dp", "ep"), "cp")),
+            out_specs=P(("dp", "ep"), "cp", "tp"),
         )
         if not self._zigzag:
             return jax.jit(fwd)
@@ -452,7 +618,8 @@ class Transformer:
         loss = functools.partial(self.loss_shard, mode=mode)
         fn = jax.shard_map(
             loss, mesh=mesh,
-            in_specs=(self.specs(), P("dp", "cp"), P("dp", "cp"), P("dp", "cp")),
+            in_specs=(self.specs(), P(("dp", "ep"), "cp"),
+                      P(("dp", "ep"), "cp"), P(("dp", "ep"), "cp")),
             out_specs=P(),
         )
         if not self._zigzag:

@@ -1,0 +1,233 @@
+"""Mixture-of-Experts FFN with expert parallelism (EP), TPU-native.
+
+No reference counterpart: the reference's FFN is dense SwiGLU and it has no
+router or expert sharding of any kind (SURVEY §2.4 "EP ❌",
+`/root/reference/models/model.py:81-95`). This module is the framework
+extension that turns the dense SwiGLU sublayer into a top-k routed MoE, with
+
+* **Expert parallelism over the mesh axis 'ep'**: each ep shard owns
+  `num_experts / ep` experts (leading expert dim of every expert weight is
+  sharded with `P('ep', ...)`). Tokens are exchanged with ONE
+  `lax.all_to_all` before and one after expert compute — the GShard/Switch
+  dispatch pattern, riding ICI like every other collective here.
+
+* **Tensor parallelism inside each expert over 'tp'**: gate/up are
+  column-sharded, down is row-sharded — the same Megatron pattern as the
+  dense FFN (`parallel/linear.py`), expressed as batched-over-experts
+  einsums so the MXU sees one big (E_local, tokens, d) x (E_local, d, f)
+  contraction instead of a Python loop over experts.
+
+* **Static shapes throughout** (XLA requirement): routing uses the
+  capacity-factor formulation — each expert accepts at most C tokens per ep
+  shard; overflow tokens fall through the residual connection (standard
+  Switch behaviour). With a generous `capacity_factor` nothing drops and
+  the layer is exactly `sum_k gate_k * expert_k(x)`, which the equivalence
+  tests exploit (routing is sharding-invariant in expectation AND in value
+  when no token drops).
+
+* **Dispatch/combine as one-hot einsums** (dense dispatch): `D[s,e,c]`
+  scatters token s to slot (e, c); `W[s,e,c]` carries the top-k gate
+  weight. einsum('sec,sd->ecd') is MXU-friendly and its transpose (the
+  backward) is the mirrored einsum — no sorts, no dynamic shapes.
+
+Auxiliary losses follow Switch/ST-MoE: load-balance loss
+`E * sum_e(frac_tokens_e * mean_prob_e)` and router z-loss
+`mean(logsumexp(router_logits)^2)`. `apply` returns LOCAL sums; the model's
+loss_shard psums them over the batch axes so the totals are independent of
+how tokens are sharded (tests assert this).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.collectives import copy_to, reduce_from
+from ..runtime.prng import fold
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class MoEFFN:
+    """Top-k routed SwiGLU experts; drop-in for the dense FFN sublayer."""
+
+    d: int                 # model dim
+    f: int                 # per-expert hidden dim
+    num_experts: int
+    top_k: int = 2
+    # Per-expert slots per ep shard: C = ceil(capacity_factor * S * k / E)
+    # where S = local tokens. >= E/k guarantees zero drops for any routing;
+    # 2.0 is a training-friendly default with rare drops.
+    capacity_factor: float = 2.0
+    # Renormalise the top-k gate weights to sum to 1 (Mixtral style). False
+    # keeps raw softmax mass (Switch style).
+    renormalize: bool = True
+    ep_size: int = 1
+    tp_size: int = 1
+    ep_axis: str = "ep"
+    tp_axis: str = "tp"
+
+    def __post_init__(self):
+        if self.num_experts % self.ep_size != 0:
+            raise ValueError(f"num_experts {self.num_experts} not divisible "
+                             f"by ep_size {self.ep_size}")
+        if self.f % self.tp_size != 0:
+            raise ValueError(f"expert ffn dim {self.f} not divisible by "
+                             f"tp_size {self.tp_size}")
+        if not (1 <= self.top_k <= self.num_experts):
+            raise ValueError(f"top_k {self.top_k} out of range for "
+                             f"{self.num_experts} experts")
+
+    # ---- init / specs ----
+
+    def init(self, key: jax.Array) -> Params:
+        E, d, f = self.num_experts, self.d, self.f
+
+        def expert_w(k, idim, odim):
+            bound = 1.0 / math.sqrt(idim)
+            return jax.random.uniform(k, (E, idim, odim), jnp.float32,
+                                      -bound, bound)
+
+        return {
+            # router kept tiny + f32; zero-init (standard: uniform routing at
+            # step 0, so early training matches the dense layer's scale)
+            "router": jnp.zeros((d, E), jnp.float32),
+            "gate": expert_w(fold(key, "gate"), d, f),
+            "up": expert_w(fold(key, "up"), d, f),
+            "down": expert_w(fold(key, "down"), f, d),
+        }
+
+    def specs(self) -> Params:
+        ep, tp = self.ep_axis, self.tp_axis
+        return {
+            "router": P(None, None),
+            "gate": P(ep, None, tp),
+            "up": P(ep, None, tp),
+            "down": P(ep, tp, None),
+        }
+
+    # ---- routing (static-shape, per ep shard) ----
+
+    def _capacity(self, tokens: int) -> int:
+        c = math.ceil(self.capacity_factor * tokens * self.top_k
+                      / self.num_experts)
+        return max(4, c)
+
+    def _route(self, logits: jax.Array) -> Tuple[jax.Array, jax.Array, Params]:
+        """(S, E) router logits -> dispatch D (S, E, C), combine W (S, E, C),
+        aux local sums."""
+        S, E = logits.shape
+        C = self._capacity(S)
+        probs = jax.nn.softmax(logits, axis=-1)            # (S, E) f32
+        topv, topi = lax.top_k(probs, self.top_k)          # (S, k)
+        if self.renormalize:
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+        # Position of each (slot, token) routing within its expert. Slot-major
+        # priority (all slot-0 picks beat slot-1 picks), token order within a
+        # slot — the Switch convention.
+        onehot = jax.nn.one_hot(topi, E, dtype=jnp.int32)  # (S, k, E)
+        flat = onehot.transpose(1, 0, 2).reshape(self.top_k * S, E)
+        pos_flat = jnp.cumsum(flat, axis=0) - flat          # (k*S, E)
+        pos = (pos_flat.reshape(self.top_k, S, E)
+               .transpose(1, 0, 2))                         # (S, k, E)
+        pos_tok = jnp.sum(pos * onehot, axis=-1)            # (S, k)
+        keep = (pos_tok < C) & (topv > 0)                   # (S, k)
+
+        d_slots = (jax.nn.one_hot(topi, E, dtype=jnp.float32)[..., None]
+                   * jax.nn.one_hot(pos_tok, C, dtype=jnp.float32)[:, :, None, :]
+                   * keep[..., None, None].astype(jnp.float32))  # (S,k,E,C)
+        D = jnp.sum(d_slots, axis=1)                        # (S, E, C)
+        W = jnp.sum(d_slots * topv[..., None, None], axis=1)
+
+        aux = {
+            # routed (pre-drop) assignment counts, the Switch f_e numerator
+            "tokens_per_expert": jnp.sum(onehot, axis=(0, 1)).astype(jnp.float32),
+            "prob_sum": jnp.sum(probs, axis=0),             # (E,)
+            "z_sum": jnp.sum(jax.nn.logsumexp(logits, axis=-1) ** 2),
+            "tokens": jnp.asarray(S, jnp.float32),
+            "dropped": jnp.sum(1.0 - keep.astype(jnp.float32)),
+        }
+        return D, W, aux
+
+    # ---- forward (per-shard, inside shard_map) ----
+
+    def apply(self, params: Params, x: jax.Array,
+              compute_dtype: jnp.dtype = jnp.float32
+              ) -> Tuple[jax.Array, Params]:
+        """x (b, t, d) -> (y (b, t, d), aux local sums).
+
+        Must run inside shard_map over ('ep', 'tp'); x is the ep shard's
+        local tokens, replicated over tp.
+        """
+        b, t, d = x.shape
+        S = b * t
+        xf = x.reshape(S, d)
+
+        # Router in f32 for a stable softmax; stop-gradient-free (the router
+        # trains through the combine weights W).
+        logits = xf.astype(jnp.float32) @ params["router"]
+        D, W, aux = self._route(logits)
+
+        xd = xf.astype(compute_dtype)
+        expert_in = jnp.einsum("sec,sd->ecd", D.astype(compute_dtype), xd)
+
+        if self.ep_size > 1:
+            # (E, C, d) -> (E/ep, ep*C, d): each ep shard receives its own
+            # experts' slots from every peer.
+            expert_in = lax.all_to_all(expert_in, self.ep_axis,
+                                       split_axis=0, concat_axis=1,
+                                       tiled=True)
+
+        # Batched Megatron FFN over the local experts: gate/up column-sharded
+        # over tp (copy_to installs the psum of input grads), down
+        # row-sharded (reduce_from sums the partial products).
+        h_in = copy_to(expert_in, self.tp_axis)
+        gate = jnp.einsum("ecd,edf->ecf", h_in,
+                          params["gate"].astype(compute_dtype))
+        up = jnp.einsum("ecd,edf->ecf", h_in,
+                        params["up"].astype(compute_dtype))
+        h = jax.nn.silu(gate) * up
+        out = jnp.einsum("ecf,efd->ecd", h,
+                         params["down"].astype(compute_dtype))
+        out = reduce_from(out, self.tp_axis)
+
+        if self.ep_size > 1:
+            out = lax.all_to_all(out, self.ep_axis,
+                                 split_axis=1, concat_axis=0, tiled=True)
+
+        y = jnp.einsum("sec,ecd->sd", W.astype(compute_dtype), out)
+        return y.reshape(b, t, d), aux
+
+
+def aux_zeros(num_experts: int) -> Params:
+    """Zero aux sums with the same structure `MoEFFN.apply` returns — used
+    as the scan unit for dense layers so MoE and dense bodies scan alike."""
+    z = jnp.zeros((), jnp.float32)
+    return {"tokens_per_expert": jnp.zeros((num_experts,), jnp.float32),
+            "prob_sum": jnp.zeros((num_experts,), jnp.float32),
+            "z_sum": z, "tokens": z, "dropped": z}
+
+
+def aux_losses(aux: Params, num_experts: int, top_k: int
+               ) -> Tuple[jax.Array, jax.Array]:
+    """(load_balance_loss, z_loss) from GLOBALLY-summed aux stats.
+
+    Switch load balance: E * sum_e(f_e * P_e) with f_e the fraction of
+    routed assignments to expert e and P_e the mean router prob — minimised
+    (== 1) by uniform routing. Callers psum the aux sums over the batch axes
+    first so the value is sharding-invariant.
+    """
+    tokens = jnp.maximum(aux["tokens"], 1.0)
+    f = aux["tokens_per_expert"] / (tokens * top_k)
+    p = aux["prob_sum"] / tokens
+    lb = num_experts * jnp.sum(f * p)
+    z = aux["z_sum"] / tokens
+    return lb, z

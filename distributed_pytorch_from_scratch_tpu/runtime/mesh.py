@@ -27,13 +27,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..config import MeshConfig
 
 DP_AXIS = "dp"
+PP_AXIS = "pp"
 CP_AXIS = "cp"
+EP_AXIS = "ep"
 TP_AXIS = "tp"
-AXIS_NAMES = (DP_AXIS, CP_AXIS, TP_AXIS)
+AXIS_NAMES = (DP_AXIS, PP_AXIS, CP_AXIS, EP_AXIS, TP_AXIS)
 
 
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """Build the ('dp', 'cp', 'tp') mesh.
+    """Build the ('dp', 'pp', 'cp', 'ep', 'tp') mesh.
 
     Replaces `init_pgm` (`/root/reference/process_manager.py:23-25`): where the
     reference carved a 1-D `torch.arange(world).view(tp_size)` grid into one
@@ -42,20 +44,23 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -
 
     The 'tp' axis is innermost (fastest-varying over devices) so TP
     collectives — the per-layer latency-critical ops, see SURVEY §3.1 —
-    ride neighbouring ICI links. 'cp' (ring-attention KV hops, once per ring
-    step) sits between, and 'dp' (one gradient all-reduce per step) is
-    outermost.
+    ride neighbouring ICI links. 'ep' (MoE all-to-all, twice per MoE layer)
+    and 'cp' (ring-attention KV hops, once per ring step) sit between;
+    'pp' (one activation ppermute per microbatch per stage boundary) and
+    'dp' (one gradient all-reduce per step) are outermost.
     """
     if devices is None:
         devices = jax.devices()
     n = cfg.world_size
     if n > len(devices):
         raise ValueError(
-            f"Mesh {cfg.dp}x{cfg.cp}x{cfg.tp} needs {n} devices but only "
-            f"{len(devices)} are visible"
+            f"Mesh {cfg.dp}x{cfg.pp}x{cfg.cp}x{cfg.ep}x{cfg.tp} needs {n} "
+            f"devices but only {len(devices)} are visible"
         )
-    grid = np.asarray(devices[:n]).reshape(cfg.dp, cfg.cp, cfg.tp)
-    return Mesh(grid, AXIS_NAMES, axis_types=(jax.sharding.AxisType.Auto,) * 3)
+    grid = np.asarray(devices[:n]).reshape(cfg.dp, cfg.pp, cfg.cp, cfg.ep,
+                                           cfg.tp)
+    return Mesh(grid, AXIS_NAMES,
+                axis_types=(jax.sharding.AxisType.Auto,) * len(AXIS_NAMES))
 
 
 def single_device_mesh() -> Mesh:
@@ -70,7 +75,9 @@ def tp_mesh(tp: int) -> Mesh:
 
 def mesh_shape(mesh: Mesh) -> MeshConfig:
     return MeshConfig(dp=mesh.shape[DP_AXIS], tp=mesh.shape[TP_AXIS],
-                      cp=mesh.shape.get(CP_AXIS, 1))
+                      cp=mesh.shape.get(CP_AXIS, 1),
+                      ep=mesh.shape.get(EP_AXIS, 1),
+                      pp=mesh.shape.get(PP_AXIS, 1))
 
 
 def named(mesh: Mesh, *spec) -> NamedSharding:

@@ -67,6 +67,18 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard Adam moments over the dp axis "
                         "(2/dp optimizer memory per device)")
+    g.add_argument("--ep_size", type=int, default=1,
+                   help="expert-parallel axis size (MoE: experts shard over "
+                        "'ep'; requires --num_experts; 'ep' also shards the "
+                        "batch for the dense sublayers)")
+    g.add_argument("--pp_size", type=int, default=1,
+                   help="pipeline-parallel axis size: layers shard into "
+                        "pp stages, microbatches flow through a GPipe "
+                        "schedule (llama family)")
+    g.add_argument("--pp_microbatches", type=int, default=0,
+                   help="microbatches per pipeline step (default pp_size; "
+                        "more microbatches = smaller bubble fraction "
+                        "(pp-1)/(m+pp-1) but smaller per-microbatch work)")
 
     g = p.add_argument_group("training")
     g.add_argument("--lr", type=float, default=3e-4)
@@ -119,6 +131,15 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "num_heads, i.e. plain MHA like the reference)")
     g.add_argument("--num_layers", type=int, default=None)
     g.add_argument("--maxlen", type=int, default=None)
+    g.add_argument("--num_experts", type=int, default=None,
+                   help="Mixture-of-Experts: swap every layer's FFN for N "
+                        "routed experts (llama family; default 0 = dense "
+                        "SwiGLU like the reference)")
+    g.add_argument("--moe_top_k", type=int, default=None,
+                   help="experts activated per token (default 2)")
+    g.add_argument("--moe_capacity_factor", type=float, default=None,
+                   help="per-expert slot headroom; overflow tokens fall "
+                        "through the residual (default 2.0)")
     g.add_argument("--remat", choices=sorted(REMAT_CHOICES),
                    default="true",
                    help="per-layer rematerialisation: 'true' = lowest "
@@ -176,11 +197,12 @@ class _ShutdownFlag:
 def train(args: argparse.Namespace) -> dict:
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
-    mesh_cfg = MeshConfig(dp=args.dp_size, tp=args.tp_size, cp=args.cp_size)
+    mesh_cfg = MeshConfig(dp=args.dp_size, tp=args.tp_size, cp=args.cp_size,
+                          ep=args.ep_size, pp=args.pp_size)
     if mesh_cfg.world_size > jax.device_count():
         raise SystemExit(
-            f"mesh {args.dp_size}x{args.cp_size}x{args.tp_size} needs "
-            f"{mesh_cfg.world_size} "
+            f"mesh {args.dp_size}x{args.pp_size}x{args.cp_size}x"
+            f"{args.ep_size}x{args.tp_size} needs {mesh_cfg.world_size} "
             f"devices; only {jax.device_count()} visible "
             f"({jax.devices()[0].platform}). For CPU testing set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count=N")
@@ -194,12 +216,17 @@ def train(args: argparse.Namespace) -> dict:
         raise SystemExit(f"--maxlen {maxlen} must be divisible by "
                          f"--cp_size {args.cp_size} (sequence is sharded "
                          f"over the 'cp' mesh axis)")
-    if args.batch_size % args.dp_size != 0:
+    if args.batch_size % (args.dp_size * args.ep_size) != 0:
         raise SystemExit(f"--batch_size {args.batch_size} must be divisible "
-                         f"by --dp_size {args.dp_size}")
-    if args.family == "gpt2" and (args.cp_size > 1 or args.sequence_parallel):
+                         f"by dp_size*ep_size "
+                         f"{args.dp_size * args.ep_size} (the batch shards "
+                         f"over both axes)")
+    if args.family == "gpt2" and (args.cp_size > 1 or args.sequence_parallel
+                                  or args.ep_size > 1 or args.num_experts
+                                  or args.pp_size > 1):
         raise SystemExit("--family gpt2 supports the dp x tp mesh only "
-                         "(no --cp_size/--sequence_parallel)")
+                         "(no --cp_size/--sequence_parallel/--num_experts/"
+                         "--ep_size/--pp_size)")
     mesh = make_mesh(mesh_cfg)
 
     dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -213,6 +240,10 @@ def train(args: argparse.Namespace) -> dict:
                       num_kv_heads=pick(args.num_kv_heads,
                                         preset.num_kv_heads),
                       num_layers=pick(args.num_layers, preset.num_layers),
+                      num_experts=pick(args.num_experts, preset.num_experts),
+                      moe_top_k=pick(args.moe_top_k, preset.moe_top_k),
+                      moe_capacity_factor=pick(args.moe_capacity_factor,
+                                               preset.moe_capacity_factor),
                       vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
     if args.family == "gpt2":
@@ -224,6 +255,8 @@ def train(args: argparse.Namespace) -> dict:
                         cp_size=args.cp_size, cp_impl=args.cp_impl,
                         cp_layout=args.cp_layout,
                         sequence_parallel=args.sequence_parallel,
+                        ep_size=args.ep_size, pp_size=args.pp_size,
+                        pp_microbatches=args.pp_microbatches,
                         remat=REMAT_CHOICES[args.remat])
     ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
                            max_steps=args.max_steps,
@@ -233,9 +266,12 @@ def train(args: argparse.Namespace) -> dict:
     # count from the actual pytree: exact for every family (cfg.num_params()
     # hardcodes the llama layout — untied head, SwiGLU, no position table)
     n_params = sum(int(x.size) for x in jax.tree.leaves(params))
-    print(f"model[{args.family}]: {n_params/1e6:.2f}M params, "
+    moe_note = (f", {cfg.num_experts} experts (top-{cfg.moe_top_k})"
+                if cfg.num_experts else "")
+    print(f"model[{args.family}]: {n_params/1e6:.2f}M params{moe_note}, "
           f"vocab={vocab_size}, "
-          f"mesh=dp{args.dp_size} x cp{args.cp_size} x tp{args.tp_size}, "
+          f"mesh=dp{args.dp_size} x pp{args.pp_size} x cp{args.cp_size} x "
+          f"ep{args.ep_size} x tp{args.tp_size}, "
           f"compute={cfg.compute_dtype}")
     opt_state = init_adam_state(params)
     start_step = 0

@@ -1,0 +1,140 @@
+"""Pipeline parallelism (GPipe schedule over the 'pp' mesh axis).
+
+The reference has no pipeline parallelism (SURVEY §2.4 "PP ❌" — its layers
+run in a single-device Python loop, `/root/reference/models/model.py:132-135`).
+The oracle is therefore the framework itself on a single-device mesh, the
+same idiom as the MoE/CP suites:
+
+* loss, full logits and every gradient leaf match the 1-device run exactly
+  (the pipeline is semantically invisible — including the subtle last-stage
+  loss masking that keeps replicated embedding/lm_head cotangents from
+  being psum-multiplied by pp);
+* multi-step training histories match (the transposed reverse-time
+  backward pipeline is drift-free over optimizer steps);
+* composition with dp and tp on one mesh;
+* static validation errors.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_from_scratch_tpu.config import (IGNORE_INDEX,
+                                                         MeshConfig,
+                                                         ModelConfig,
+                                                         OptimizerConfig)
+from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
+from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+from distributed_pytorch_from_scratch_tpu.training.optim import (
+    init_adam_state)
+from distributed_pytorch_from_scratch_tpu.training.train_step import (
+    build_train_step)
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=4,
+                  vocab_size=96, maxlen=64)
+
+
+def make_batch(key, batch=8, t=16, vocab=96):
+    k1, k2 = jax.random.split(key)
+    input_ids = jax.random.randint(k1, (batch, t), 0, vocab)
+    target_ids = jax.random.randint(k2, (batch, t), 0, vocab)
+    mask = jax.random.bernoulli(jax.random.fold_in(key, 9), 0.2, (batch, t))
+    target_ids = jnp.where(mask, IGNORE_INDEX, target_ids)
+    position_ids = jnp.tile(jnp.arange(t)[None, :], (batch, 1))
+    return input_ids, target_ids, position_ids
+
+
+MESHES = [
+    # (dp, pp, tp, microbatches); 0 microbatches -> pp (minimum schedule)
+    ("pp2", 1, 2, 1, 0),
+    ("pp4", 1, 4, 1, 0),
+    ("pp2_m8", 1, 2, 1, 8),   # deep pipeline: 8 microbatches of 1
+    ("pp2tp2", 1, 2, 2, 0),
+    ("dp2pp2tp2", 2, 2, 2, 4),
+]
+
+
+@pytest.mark.parametrize("name,dp,pp,tp,m", MESHES)
+def test_loss_logits_grads_match_single_device(name, dp, pp, tp, m):
+    key = jax.random.key(0)
+    ids, tgt, pos = make_batch(jax.random.key(2))
+
+    ref = Transformer(CFG)
+    mesh1 = make_mesh(MeshConfig())
+    params = ref.init(key)
+    l_ref, g_ref = jax.value_and_grad(ref.make_loss(mesh1))(
+        params, ids, tgt, pos)
+    logits_ref = ref.make_forward(mesh1)(params, ids, pos)
+
+    model = Transformer(CFG, tp_size=tp, pp_size=pp, pp_microbatches=m)
+    mesh = make_mesh(MeshConfig(dp=dp, pp=pp, tp=tp))
+    sp = jax.device_put(params, model.shardings(mesh))
+    l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(sp, ids, tgt, pos)
+    logits_sh = model.make_forward(mesh)(sp, ids, pos)
+
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(logits_ref),
+                               rtol=1e-4, atol=1e-4)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_multi_step_history_matches_single_device():
+    """20 Adam steps on dp2 x pp2 x tp2 reproduce the 1-device loss history
+    (the reference's multi-step equivalence idiom, SURVEY §4 check 3)."""
+    ocfg = OptimizerConfig(lr=1e-3, warmup_steps=5, max_steps=30)
+    histories = {}
+    for name, shape, kw in [
+            ("single", dict(), dict()),
+            ("dp2pp2tp2", dict(dp=2, pp=2, tp=2),
+             dict(tp_size=2, pp_size=2, pp_microbatches=2))]:
+        model = Transformer(CFG, **kw)
+        mesh = make_mesh(MeshConfig(**shape))
+        params = jax.device_put(model.init(jax.random.key(0)),
+                                model.shardings(mesh))
+        opt = init_adam_state(params)
+        step = build_train_step(model, mesh, ocfg)
+        losses = []
+        for i in range(20):
+            ids, tgt, pos = make_batch(jax.random.key(100 + i))
+            params, opt, loss = step(params, opt, ids, tgt, pos)
+            losses.append(float(loss))
+        histories[name] = losses
+    np.testing.assert_allclose(histories["single"], histories["dp2pp2tp2"],
+                               rtol=2e-4)
+
+
+def test_pp_composes_with_cp():
+    """pp x cp on one mesh: the ring-attention sequence sharding runs inside
+    each pipeline stage."""
+    ids, tgt, pos = make_batch(jax.random.key(3), batch=4, t=32)
+    ref = Transformer(CFG)
+    params = ref.init(jax.random.key(0))
+    l_ref = ref.make_loss(make_mesh(MeshConfig()))(params, ids, tgt, pos)
+
+    model = Transformer(CFG, pp_size=2, cp_size=2, tp_size=2)
+    mesh = make_mesh(MeshConfig(pp=2, cp=2, tp=2))
+    sp = jax.device_put(params, model.shardings(mesh))
+    l_sh = model.make_loss(mesh)(sp, ids, tgt, pos)
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="divisible"):
+        Transformer(CFG, pp_size=3)  # 4 layers % 3 != 0
+    with pytest.raises(ValueError, match="MoE"):
+        Transformer(ModelConfig(num_layers=4, num_experts=4), pp_size=2)
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        Transformer(CFG, pp_size=2, sequence_parallel=True)
+    with pytest.raises(ValueError, match="bubbles"):
+        Transformer(CFG, pp_size=4, pp_microbatches=2)
+    # local batch not divisible by microbatches -> runtime error
+    model = Transformer(CFG, pp_size=2, pp_microbatches=3)
+    mesh = make_mesh(MeshConfig(pp=2))
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    ids, tgt, pos = make_batch(jax.random.key(1), batch=4)
+    with pytest.raises(ValueError, match="not divisible"):
+        model.make_loss(mesh)(params, ids, tgt, pos)
