@@ -87,6 +87,11 @@ def get_eval_args(argv=None) -> argparse.Namespace:
                    help="must match the trained model (GQA, llama family)")
     g.add_argument("--num_layers", type=int, default=None)
     g.add_argument("--maxlen", type=int, default=None)
+    g.add_argument("--num_experts", type=int, default=None,
+                   help="MoE checkpoint shape (must match training); eval "
+                        "runs the experts unsharded (ep=1)")
+    g.add_argument("--moe_top_k", type=int, default=None)
+    g.add_argument("--moe_capacity_factor", type=float, default=None)
     g.add_argument("--bf16", action="store_true", default=True)
     g.add_argument("--no-bf16", dest="bf16", action="store_false")
 
@@ -262,13 +267,19 @@ def evaluate(args: argparse.Namespace) -> dict:
                       num_kv_heads=pick(args.num_kv_heads,
                                         preset.num_kv_heads),
                       num_layers=pick(args.num_layers, preset.num_layers),
+                      num_experts=pick(args.num_experts, preset.num_experts),
+                      moe_top_k=pick(args.moe_top_k, preset.moe_top_k),
+                      moe_capacity_factor=pick(args.moe_capacity_factor,
+                                               preset.moe_capacity_factor),
                       vocab_size=vocab_size, maxlen=maxlen,
                       compute_dtype="bfloat16" if args.bf16 else "float32")
-    # val loss runs the full 3-D mesh; decoding runs the cp=1 path on the
-    # same params (models/decode.py), with its batch replicated over dp/cp.
+    # val loss runs the full dp x cp x tp mesh (pp/ep stay 1 at eval);
+    # decoding runs the cp=1 path on the same params (models/decode.py),
+    # with its batch replicated over dp/cp.
     if args.family == "gpt2":
-        if args.cp_size > 1:
-            raise SystemExit("--family gpt2 supports dp x tp only")
+        if args.cp_size > 1 or cfg.num_experts:
+            raise SystemExit("--family gpt2 supports dp x tp only "
+                             "(no --cp_size/--num_experts)")
         from .models.gpt2 import GPT2Transformer
         model_val = GPT2Transformer(cfg, tp_size=args.tp_size)
         model = model_val

@@ -71,8 +71,14 @@ def chip_peak_flops(device: Optional[jax.Device] = None) -> float:
 
 def model_flops_per_step(cfg, batch: int, seqlen: int) -> float:
     """Model FLOPs for one fwd+bwd train step (no remat recompute counted):
-    6N per token + the 12*L*h*T^2*hd attention term."""
+    6N_active per token + the 12*L*h*T^2*hd attention term. For MoE models
+    only the top_k experts a token is routed through count (the standard
+    active-parameter MFU convention); dropped-token underflow is ignored."""
     n = cfg.num_params()
+    if getattr(cfg, "num_experts", 0):
+        inactive = ((cfg.num_experts - cfg.moe_top_k)
+                    * 3 * cfg.attn_dim * cfg.ffn_dim)
+        n -= cfg.num_layers * max(0, inactive)
     return (6 * n * batch * seqlen
             + 12 * cfg.num_layers * batch * cfg.num_heads
             * seqlen * seqlen * cfg.head_dim)

@@ -121,6 +121,40 @@ def test_pp_composes_with_cp():
     np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
 
 
+def test_pp_checkpoint_resume(tmp_path):
+    """pp-sharded layer stacks round-trip through save/load: resume-step
+    loss equals the straight-through loss."""
+    from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+        load_checkpoint, save_checkpoint)
+
+    ocfg = OptimizerConfig(lr=1e-3, warmup_steps=2, max_steps=20)
+    model = Transformer(CFG, tp_size=2, pp_size=2, pp_microbatches=2)
+    mesh = make_mesh(MeshConfig(pp=2, tp=2))
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    opt = init_adam_state(params)
+    step = build_train_step(model, mesh, ocfg)
+    for i in range(2):
+        ids, tgt, pos = make_batch(jax.random.key(300 + i))
+        params, opt, loss = step(params, opt, ids, tgt, pos)
+    save_checkpoint(str(tmp_path), 2, float(loss), params, model.specs(),
+                    tp_size=2, opt_state=opt)
+
+    ids, tgt, pos = make_batch(jax.random.key(302))
+    _, _, loss_cont = step(params, opt, ids, tgt, pos)
+
+    template = model.init(jax.random.key(7))
+    p2, o2, st = load_checkpoint(str(tmp_path), 2, template, model.specs(),
+                                 with_opt=True)
+    p2 = jax.device_put(p2, model.shardings(mesh))
+    o2 = jax.device_put(o2, o2.__class__(
+        step=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+        mu=model.shardings(mesh), nu=model.shardings(mesh)))
+    _, _, loss_resume = step(p2, o2, ids, tgt, pos)
+    np.testing.assert_allclose(float(loss_resume), float(loss_cont),
+                               rtol=1e-6)
+
+
 def test_validation_errors():
     with pytest.raises(ValueError, match="divisible"):
         Transformer(CFG, pp_size=3)  # 4 layers % 3 != 0
