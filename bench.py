@@ -8,7 +8,7 @@ reference's experiment scale (batch 32, seqlen 1000, bf16 — `train.py:41`,
 under the bench driver).
 
 Flags cover the other BASELINE.md configs:
-    --model {45m,gpt2-124m,tiny}   model preset (BASELINE configs 1/3)
+    --model {45m,gpt2-124m,tiny,45m-moe8}   model preset (BASELINE 1/3 + MoE)
     --remat {true,dots,false}      rematerialisation policy
     --batch N --seqlen N           override the experiment shape
     --dp N --tp N                  mesh axes (world = dp*tp must match chips)
@@ -44,7 +44,7 @@ from distributed_pytorch_from_scratch_tpu.training.train_step import (
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="45m",
-                   choices=["45m", "gpt2-124m", "tiny"])
+                   choices=["45m", "gpt2-124m", "tiny", "45m-moe8"])
     # "dots" saves matmul outputs + the flash kernel's o/lse residuals
     # (models/transformer.py); measured faster than full remat at every
     # config that fits, and the 45M b32xt1000 run fits on a 16G chip.

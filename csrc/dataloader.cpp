@@ -335,4 +335,38 @@ void collate_batch(const int32_t* flat, const int32_t* lens, int32_t batch,
     }
 }
 
+// Indexed collate over a PACKED corpus: `packed` holds every sequence of the
+// dataset back to back, `offsets[i]..offsets[i+1]` delimiting sequence i
+// (offsets has n_seq+1 entries). `idxs` selects the batch's rows in order.
+// Each row is truncated to min(len, cap) tokens first — the same
+// maxlen-1 truncation TokenDataset.__getitem__ applies — then collated with
+// the reference semantics above. One call replaces the per-batch Python
+// gather + flatten + collate, so a prefetch thread spends its time in this
+// GIL-released loop instead of the interpreter.
+void collate_indexed(const int32_t* packed, const int64_t* offsets,
+                     const int32_t* idxs, int32_t batch, int32_t cap,
+                     int32_t width, int32_t bos, int32_t eos, int32_t ignore,
+                     int32_t* input_ids, int32_t* target_ids,
+                     int32_t* position_ids) {
+    for (int32_t i = 0; i < batch; ++i) {
+        int64_t st = offsets[idxs[i]];
+        int64_t L64 = offsets[idxs[i] + 1] - st;
+        int32_t L = L64 > cap ? cap : (int32_t)L64;     // maxlen-1 truncation
+        int32_t Lc = L < width - 1 ? L : width - 1;     // defensive clamp
+        const int32_t* src = packed + st;
+        int32_t* in = input_ids + (int64_t)i * width;
+        int32_t* tg = target_ids + (int64_t)i * width;
+        int32_t* ps = position_ids + (int64_t)i * width;
+        in[0] = bos;
+        for (int32_t j = 0; j < Lc; ++j) {
+            in[j + 1] = src[j];
+            tg[j] = src[j];
+        }
+        for (int32_t j = Lc + 1; j < width; ++j) in[j] = eos;
+        tg[Lc] = eos;
+        for (int32_t j = Lc + 1; j < width; ++j) tg[j] = ignore;
+        for (int32_t j = 0; j < width; ++j) ps[j] = j;
+    }
+}
+
 }  // extern "C"

@@ -120,6 +120,9 @@ MODEL_PRESETS = {
                              num_layers=12, vocab_size=50257, maxlen=1024),
     "tiny": ModelConfig(attn_dim=128, ffn_dim=512, num_heads=4,
                         num_layers=2, vocab_size=1024, maxlen=256),
+    # the 45m shape with its FFN swapped for 8 routed experts (top-2):
+    # ~160M total params, 45m-class active compute per token
+    "45m-moe8": ModelConfig(num_experts=8, moe_top_k=2),
 }
 
 

@@ -63,6 +63,22 @@ class TokenDataset:
             tokens = tokens[: self.maxlen - 1]
         return tokens
 
+    def packed(self):
+        """(packed int32, offsets int64) — the whole split concatenated, for
+        the native indexed-collate fast path (csrc collate_indexed gathers
+        rows straight from this buffer; truncation to maxlen-1 happens in
+        C++ via its `cap` argument). Built lazily, cached."""
+        if not hasattr(self, "_packed"):
+            seqs = self.data[self.split]
+            lens = np.fromiter((len(s) for s in seqs), np.int64, len(seqs))
+            offsets = np.zeros(len(seqs) + 1, np.int64)
+            np.cumsum(lens, out=offsets[1:])
+            packed = np.empty(int(offsets[-1]), np.int32)
+            for i, s in enumerate(seqs):
+                packed[offsets[i]:offsets[i + 1]] = s
+            self._packed = (packed, offsets)
+        return self._packed
+
 
 def collate(batch: List[List[int]], bos: int, eos: int, ignore_idx: int,
             pad_to: Optional[int] = None) -> Dict[str, np.ndarray]:
@@ -141,6 +157,26 @@ class DataLoader:
             order = np.random.RandomState(self.seed + epoch).permutation(n)
         bs = self.batch_size
         end = n - n % bs if self.drop_last else n
+        if self._use_native:
+            # Indexed fast path: ONE GIL-released C++ call gathers the rows
+            # from the packed corpus, truncates, and collates — no per-row
+            # Python list handling. Byte-identical to the slow path
+            # (tests/test_native_data.py).
+            from .native import native_collate_indexed
+            ds = self.dataset
+            packed, offsets = ds.packed()
+            cap = ds.maxlen - 1
+            for st in range(0, end, bs):
+                idxs = order[st : st + bs]
+                if self.pad_to is None:
+                    lens = offsets[idxs + 1] - offsets[idxs]
+                    width = int(min(lens.max(), cap)) + 1
+                else:
+                    width = self.pad_to
+                yield native_collate_indexed(packed, offsets, idxs, cap,
+                                             width, ds.bos, ds.eos,
+                                             self.ignore_idx)
+            return
         for st in range(0, end, bs):
             idxs = order[st : st + bs]
             batch = [self.dataset[int(i)] for i in idxs]

@@ -80,6 +80,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.tok_free.argtypes = [ctypes.c_void_p]
     lib.collate_batch.argtypes = [ctypes.POINTER(ctypes.c_int32)] * 2 + \
         [ctypes.c_int32] * 5 + [ctypes.POINTER(ctypes.c_int32)] * 3
+    lib.collate_indexed.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32)] + [ctypes.c_int32] * 6 + \
+        [ctypes.POINTER(ctypes.c_int32)] * 3
     _lib = lib
     return _lib
 
@@ -200,5 +204,31 @@ def native_collate(batch: List[List[int]], bos: int, eos: int,
     as_p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
     lib.collate_batch(as_p(flat), as_p(lens), n, width, bos, eos, ignore_idx,
                       as_p(input_ids), as_p(target_ids), as_p(position_ids))
+    return {"input_ids": input_ids, "target_ids": target_ids,
+            "position_ids": position_ids}
+
+
+def native_collate_indexed(packed: np.ndarray, offsets: np.ndarray,
+                           idxs: np.ndarray, cap: int, width: int,
+                           bos: int, eos: int, ignore_idx: int) -> dict:
+    """Whole-batch gather + truncate + collate in ONE C++ call over the
+    packed corpus (csrc/dataloader.cpp::collate_indexed). `cap` is the
+    maxlen-1 truncation limit TokenDataset applies; `width` the fixed pad
+    length. ctypes releases the GIL for the call's duration, so a prefetch
+    thread runs it concurrently with the training loop."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(f"native library unavailable: {_lib_err}")
+    assert packed.dtype == np.int32 and offsets.dtype == np.int64
+    n = len(idxs)
+    idxs = np.ascontiguousarray(idxs, np.int32)
+    input_ids = np.empty((n, width), np.int32)
+    target_ids = np.empty((n, width), np.int32)
+    position_ids = np.empty((n, width), np.int32)
+    as_p = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    lib.collate_indexed(
+        as_p(packed), offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        as_p(idxs), n, cap, width, bos, eos, ignore_idx,
+        as_p(input_ids), as_p(target_ids), as_p(position_ids))
     return {"input_ids": input_ids, "target_ids": target_ids,
             "position_ids": position_ids}

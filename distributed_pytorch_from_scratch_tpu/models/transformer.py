@@ -496,7 +496,11 @@ class Transformer:
         # vma: the carried activation varies over 'pp' (stage-dependent) and
         # over the batch axes (x is batch-sharded), like y itself.
         carry0 = jnp.zeros((mb, t, d), x.dtype)
-        carry0 = lax.pvary(carry0, ("pp", "dp", "ep", "cp"))
+        axes = ("pp", "dp", "ep", "cp")
+        if hasattr(lax, "pcast"):
+            carry0 = lax.pcast(carry0, axes, to="varying")
+        else:
+            carry0 = lax.pvary(carry0, axes)
         _, outs = lax.scan(pipe_step, carry0,
                            jnp.arange(M + pp - 1, dtype=jnp.int32))
         # outs[last + m] is microbatch m off the last stage; psum broadcasts
