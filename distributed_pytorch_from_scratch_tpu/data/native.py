@@ -222,6 +222,16 @@ def native_collate_indexed(packed: np.ndarray, offsets: np.ndarray,
     assert packed.dtype == np.int32 and offsets.dtype == np.int64
     n = len(idxs)
     idxs = np.ascontiguousarray(idxs, np.int32)
+    # Mirror native_collate's guard: the C++ side clamps rows to width-1
+    # defensively, which would otherwise turn an undersized width into
+    # silently truncated batches (ADVICE r2) instead of the error the
+    # numpy path raises.
+    if n:
+        idx64 = idxs.astype(np.int64)
+        longest = int(min((offsets[idx64 + 1] - offsets[idx64]).max(), cap))
+        assert width >= longest + 1, (
+            f"pad width {width} < longest selected row + 1 ({longest + 1}) "
+            f"after cap {cap}")
     input_ids = np.empty((n, width), np.int32)
     target_ids = np.empty((n, width), np.int32)
     position_ids = np.empty((n, width), np.int32)

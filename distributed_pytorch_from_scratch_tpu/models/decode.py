@@ -21,6 +21,13 @@ so the same checkpoint params work unchanged; under grouped-query attention
 the caches are num_heads/num_kv_heads x smaller than the query-head count
 (the GQA decode memory win). Decode is TP-only (dp=cp=1), like the
 reference's eval (`test.py` runs the TP mesh it trained with).
+
+The decoder is generic over the model FAMILY via three hooks each family
+class declares (`uses_rope`, `attn_norm_key`, `ffn_norm_key`) plus duck
+typing on the module dict: the gpt2 family (learned position embeddings
+added at the input, LayerNorm, gelu MLP, TIED lm_head) decodes through the
+same prefill + fused-loop machinery as llama (VERDICT r2 #6). Families with
+learned positions expose `max_decode_positions`; the buffer must fit it.
 """
 
 from __future__ import annotations
@@ -60,13 +67,15 @@ def _qkv(model: Transformer, lp: Params, y: jax.Array, dtype):
     return q, k, v
 
 
-def _expand_groups(model: Transformer, k: jax.Array, v: jax.Array):
-    """Repeat kv heads to the query-head count (dense-attention consumers)."""
-    group = model.num_local_heads // model.num_local_kv_heads
-    if group > 1:
-        k = jnp.repeat(k, group, axis=1)
-        v = jnp.repeat(v, group, axis=1)
-    return k, v
+def _embed(model, params: Params, ids: jax.Array, pos: jax.Array, dtype):
+    """Token embedding (+ the learned position embedding for families
+    without RoPE — gpt2's positions enter HERE, mirroring
+    `GPT2Transformer.forward_shard`)."""
+    x = model.embedding.apply(params["embedding"], ids)
+    if not model.uses_rope:
+        x = x + jnp.take(params["pos_embedding"]["weight"], pos, axis=0,
+                         mode="clip")
+    return x.astype(dtype)
 
 
 def _finish_block(model: Transformer, lp: Params, x: jax.Array,
@@ -76,7 +85,8 @@ def _finish_block(model: Transformer, lp: Params, x: jax.Array,
     b, t = x.shape[0], x.shape[1]
     o = o.transpose(0, 2, 1, 3).reshape(b, t, model.num_local_heads * model.cfg.head_dim)
     x = x + m["wo"].apply(lp["wo"], o, dtype)
-    y = m["norm2"].apply(lp["norm2"], x)
+    nk = model.ffn_norm_key
+    y = m[nk].apply(lp[nk], x)
     if model.is_moe:
         ff, _ = m["moe"].apply(lp["moe"], y, dtype)  # aux unused at decode
         # Decode replicates the batch over 'ep' (in_specs P(None, None))
@@ -85,6 +95,9 @@ def _finish_block(model: Transformer, lp: Params, x: jax.Array,
         # the identical copies: value-identity, clears the tag so the scan
         # carry and the P(None, None) out_specs stay ep-invariant.
         return x + lax.pmean(ff, "ep")
+    if "fc" in m:  # gpt2 family: gelu MLP
+        h = jax.nn.gelu(m["fc"].apply(lp["fc"], y, dtype), approximate=True)
+        return x + m["proj"].apply(lp["proj"], h, dtype)
     g = m["gate_proj"].apply(lp["gate_proj"], y, dtype)
     u = m["up_proj"].apply(lp["up_proj"], y, dtype)
     return x + m["down_proj"].apply(lp["down_proj"], jax.nn.silu(g) * u, dtype)
@@ -92,10 +105,16 @@ def _finish_block(model: Transformer, lp: Params, x: jax.Array,
 
 def _logits_last(model: Transformer, params: Params, x_last: jax.Array,
                  dtype) -> jax.Array:
-    """Final norm + lm_head on (b, 1, d); returns the LOCAL vocab shard
-    (b, vocab_padded/tp) with padded columns masked (mirrors forward_shard)."""
+    """Final norm + head on (b, 1, d); returns the LOCAL vocab shard
+    (b, vocab_padded/tp) with padded columns masked (mirrors forward_shard).
+    Families without an lm_head module tie the head to the vocab-parallel
+    token embedding (gpt2) — same local-logits layout either way."""
     x = model.final_norm.apply(params["norm"], x_last)
-    logits = model.lm_head.apply(params["lm_head"], x, dtype)[:, 0, :]
+    if hasattr(model, "lm_head"):
+        logits = model.lm_head.apply(params["lm_head"], x, dtype)[:, 0, :]
+    else:
+        w = params["embedding"]["weight"].astype(dtype)   # (vp/tp, d)
+        logits = (x.astype(dtype) @ w.T)[:, 0, :]
     if model.vocab_padded != model.cfg.vocab_size:
         local_v = logits.shape[-1]
         start = lax.axis_index("tp") * local_v
@@ -113,17 +132,21 @@ def _prefill(model: Transformer, params: Params, buf: jax.Array,
     >= prompt_len hold padding — they are re-written by decode steps before
     any query can attend to them."""
     b, t = buf.shape
-    x = model.embedding.apply(params["embedding"], buf).astype(dtype)
     pos = jnp.tile(jnp.arange(t, dtype=jnp.int32)[None, :], (b, 1))
-    cos = jnp.take(cos_t, pos, axis=0, mode="clip")
-    sin = jnp.take(sin_t, pos, axis=0, mode="clip")
+    x = _embed(model, params, buf, pos, dtype)
+    if model.uses_rope:
+        cos = jnp.take(cos_t, pos, axis=0, mode="clip")
+        sin = jnp.take(sin_t, pos, axis=0, mode="clip")
 
     def body(x, lp):
-        y = model._mods["norm1"].apply(lp["norm1"], x)
+        nk = model.attn_norm_key
+        y = model._mods[nk].apply(lp[nk], x)
         q, k, v = _qkv(model, lp, y, dtype)
-        q, k = apply_rotary(q, k, cos, sin)
-        ke, ve = _expand_groups(model, k, v)
-        o = causal_attention(q, ke, ve, impl=model.attn_impl)
+        if model.uses_rope:
+            q, k = apply_rotary(q, k, cos, sin)
+        # grouped k/v pass straight through: every causal_attention impl
+        # routes query-head groups onto the kv heads itself (ops/attention.py)
+        o = causal_attention(q, k, v, impl=model.attn_impl)
         x = _finish_block(model, lp, x, o, dtype)
         return x, (k, v)  # caches stay at kv_heads (see _qkv)
 
@@ -139,17 +162,20 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
     """One single-token step at position `cur`: writes the token's K/V into
     the caches, attends over cache[0..cur], returns (k', v', logits)."""
     b = token.shape[0]
-    x = model.embedding.apply(params["embedding"], token[:, None]).astype(dtype)
     p1 = jnp.full((b, 1), cur, jnp.int32)
-    cos = jnp.take(cos_t, p1, axis=0, mode="clip")
-    sin = jnp.take(sin_t, p1, axis=0, mode="clip")
+    x = _embed(model, params, token[:, None], p1, dtype)
+    if model.uses_rope:
+        cos = jnp.take(cos_t, p1, axis=0, mode="clip")
+        sin = jnp.take(sin_t, p1, axis=0, mode="clip")
     visible = (jnp.arange(buf_len) <= cur)[None, None, None, :]
 
     def body(x, layer_in):
         lp, k_cache, v_cache = layer_in
-        y = model._mods["norm1"].apply(lp["norm1"], x)
+        nk = model.attn_norm_key
+        y = model._mods[nk].apply(lp[nk], x)
         q, k, v = _qkv(model, lp, y, dtype)   # q: (b, h, 1, hd); kv: kvh
-        q, k = apply_rotary(q, k, cos, sin)
+        if model.uses_rope:
+            q, k = apply_rotary(q, k, cos, sin)
         k_cache = lax.dynamic_update_slice_in_dim(
             k_cache, k.astype(k_cache.dtype), cur, axis=2)
         v_cache = lax.dynamic_update_slice_in_dim(
@@ -196,12 +222,16 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
     dtype = resolve_dtype(cfg.compute_dtype)
     # RoPE tables cover the whole decode buffer even past the model's
     # trained maxlen (positions used to silently clip to the last table row
-    # when buf_len > maxlen — ADVICE r1).
+    # when buf_len > maxlen — ADVICE r1). Families with learned positions
+    # instead hard-cap the buffer (GreedyDecoder validates).
     table_len = max(cfg.maxlen, buf_len)
 
     def shard_fn(params, buf, prompt_len, eos_id, max_total_len):
         b, _ = buf.shape
-        cos_t, sin_t = rope_tables(table_len, cfg.head_dim, cfg.rope_theta)
+        cos_t = sin_t = None
+        if model.uses_rope:
+            cos_t, sin_t = rope_tables(table_len, cfg.head_dim,
+                                       cfg.rope_theta)
         ks, vs, logits = _prefill(model, params, buf, prompt_len,
                                   cos_t, sin_t, dtype)
 
@@ -266,6 +296,12 @@ class GreedyDecoder:
         if model.cp_size != 1:
             raise ValueError("decode is TP-only; build the decoder with a "
                              "cp_size=1 model (same params load fine)")
+        cap = getattr(model, "max_decode_positions", None)
+        if cap is not None and buf_len > cap:
+            raise ValueError(
+                f"buf_len {buf_len} exceeds the model's learned position "
+                f"table ({cap}); clamp the buffer (evaluate.greedy_decode "
+                f"does) or retrain with a larger maxlen")
         self.model = model
         self.mesh = mesh
         self.buf_len = buf_len

@@ -89,6 +89,13 @@ class GPT2Transformer:
 
     # ---- static properties ----
 
+    # family hooks for the generic KV decoder (models/decode.py): learned
+    # position embeddings instead of RoPE, LayerNorm module keys, MHA
+    uses_rope = False
+    attn_norm_key = "ln1"
+    ffn_norm_key = "ln2"
+    is_moe = False  # dense family; loss_shard and the decoder consult this
+
     @property
     def d(self) -> int:
         return self.cfg.attn_dim
@@ -219,10 +226,14 @@ class GPT2Transformer:
 
     # ---- everything else is the shared machinery (see module docstring) ----
 
-    is_moe = False  # dense family; loss_shard consults this
+    @property
+    def num_local_kv_heads(self) -> int:
+        return self.num_local_heads  # MHA: the decoder's caches are full-size
 
     def _forward_with_aux(self, params: Params, input_ids: jax.Array,
-                          position_ids: jax.Array):
+                          position_ids: jax.Array,
+                          head_layout: str = "replicated"):
+        # head_layout is a pipeline concern; this family is pp_size == 1
         return self.forward_shard(params, input_ids, position_ids), None
 
     _zigzag = Transformer._zigzag

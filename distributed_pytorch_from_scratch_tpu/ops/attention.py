@@ -19,9 +19,22 @@ import jax.numpy as jnp
 MASK_VALUE = -10000.0  # reference uses -10000., model.py:75
 
 
+def repeat_kv(q: jax.Array, k: jax.Array, v: jax.Array):
+    """Expand grouped-query k/v (b, kv_heads, t, d) to q's head count for
+    dense consumers. Identity when the head counts already match."""
+    group = q.shape[1] // k.shape[1]
+    if group > 1:
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
+    return k, v
+
+
 def causal_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """q, k, v: (b, heads, t, head_dim) -> (b, heads, t, head_dim)."""
+    """q: (b, heads, t, head_dim) -> (b, heads, t, head_dim); k/v may carry
+    fewer (grouped-query) heads — expanded here (the flash kernel instead
+    routes blocks, ops/pallas/flash_attention.py)."""
     *_, t, head_dim = q.shape
+    k, v = repeat_kv(q, k, v)
     scale = 1.0 / math.sqrt(head_dim)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     mask = jnp.triu(jnp.ones((t, t), dtype=bool), k=1)

@@ -49,10 +49,20 @@ def copy_to(x: jax.Array, axis: str = "tp") -> jax.Array:
     Megatron's f operator — placed at the input of a column-parallel block so
     each shard's input-gradient contributions are summed
     (reference `Copy`, `/root/reference/models/comm_ops.py:47-60`).
+
+    No-op when `x` is already varying over `axis`: an already-varying input
+    got its tag from an upstream collective (e.g. the sequence-parallel
+    all-gather) whose own transpose performs the gradient sum — a second
+    pvary would be ill-typed, and the psum belongs to that producer.
     """
+    vma = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    need = tuple(a for a in axes if a not in vma)
+    if not need:
+        return x
     if hasattr(lax, "pcast"):
-        return lax.pcast(x, axis, to="varying")
-    return lax.pvary(x, axis)
+        return lax.pcast(x, need, to="varying")
+    return lax.pvary(x, need)
 
 
 def reduce_from(x: jax.Array, axis: str = "tp") -> jax.Array:

@@ -17,6 +17,14 @@ Forward + backward are both Pallas kernels wired through `jax.custom_vjp`
 (the backward recomputes p = exp(s - logsumexp) blockwise from the saved
 row-logsumexp, the standard flash-attention-2 scheme). Runs compiled on
 TPU and in interpreter mode on CPU (used by the cluster-free tests).
+
+**Grouped-query attention is native to the kernels** (VERDICT r2 #3): when
+k/v arrive with fewer heads than q (hkv < hq), the BlockSpec index maps
+route query-head row `b*hq + h` to kv row `b*hkv + h // group` — no
+`jnp.repeat` materialises the expanded K/V in HBM, so the GQA bandwidth
+saving survives training, not just decode. The dk/dv backward accumulates
+over the `group` query heads of each kv head through an extra sequential
+grid dimension.
 """
 
 from __future__ import annotations
@@ -118,7 +126,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_ref[:] + jnp.log(l_safe)          # (bq, 1)
 
 
-def _fwd_call(q, k, v, *, t_real: int, block_q: int, block_k: int):
+def _kv_row(bh, hq: int, hkv: int):
+    """BlockSpec index-map routing for grouped-query attention: query-head
+    row `b*hq + h` reads kv row `b*hkv + h // group`. Identity when
+    hq == hkv."""
+    group = hq // hkv
+    return (bh // hq) * hkv + (bh % hq) // group
+
+
+def _q_row(bkv, g, hq: int, hkv: int):
+    """Inverse routing for the dk/dv backward: kv row `b*hkv + hk` with
+    group offset g reads query-head row `b*hq + hk*group + g`."""
+    group = hq // hkv
+    return (bkv // hkv) * hq + (bkv % hkv) * group + g
+
+
+def _fwd_call(q, k, v, *, t_real: int, block_q: int, block_k: int,
+              hq: int, hkv: int):
     bh, t_pad, d = q.shape
     num_qb = t_pad // block_q
     num_kb = t_pad // block_k
@@ -128,14 +152,15 @@ def _fwd_call(q, k, v, *, t_real: int, block_q: int, block_k: int):
         _fwd_kernel, scale=scale, t_real=t_real,
         block_q=block_q, block_k=block_k, num_kb=num_kb)
 
+    kv = lambda b: _kv_row(b, hq, hkv)
     flops = 4 * t_real * t_real * d * bh // 2  # causal: half the square
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -205,11 +230,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float, t_real: int,
-                block_q: int, block_k: int, num_qb: int):
+                block_q: int, block_k: int, num_qb: int, group: int = 1):
+    """dk/dv accumulate over the sequential grid dim 2 = (g, qi) — under
+    grouped-query attention every one of a kv head's `group` query heads
+    contributes; the index maps route each (g, qi) step to its query row."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    gq = pl.program_id(2)
+    qi = gq % num_qb
 
-    @pl.when(qi == 0)
+    @pl.when(gq == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -242,7 +271,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dst, q_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == num_qb - 1)
+    @pl.when(gq == group * num_qb - 1)
     def _finalize():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -284,11 +313,56 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, *, t_real: int, block_q: int, block_k: int):
+def _bwd_fused_gqa_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dq_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          scale: float, t_real: int, group: int):
+    """Grouped-query fused backward: grid (b*hkv, group). Each step handles
+    one query head of the kv head's group — dq writes through directly,
+    dk/dv accumulate in VMEM scratch across the sequential group dim."""
+    g = pl.program_id(1)
+
+    @pl.when(g == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q, k, v, do = q_ref[...], k_ref[...], v_ref[...], do_ref[...]
+    t_pad = q.shape[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    row = jax.lax.broadcasted_iota(jnp.int32, (t_pad, t_pad), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (t_pad, t_pad), 1)
+    live = (col <= row) & (col < t_real) & (row < t_real)
+    s = jnp.where(live, s, MASK)
+    p = jnp.exp(s - lse_ref[...])                            # (t, t) f32
+    dv_acc[:] += jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta_ref[...]) * scale).astype(q.dtype)
+    dq_ref[...] = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_acc[:] += jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(g == group - 1)
+    def _finalize():
+        dk_ref[...] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[...] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, *, t_real: int, block_q: int, block_k: int,
+              hq: int, hkv: int):
     bh, t_pad, d = q.shape
+    bhkv = k.shape[0]
+    group = hq // hkv
     num_qb = t_pad // block_q
     num_kb = t_pad // block_k
     scale = 1.0 / math.sqrt(d)
+    kv = lambda b: _kv_row(b, hq, hkv)
 
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)                           # (bh, t_pad, 1)
@@ -301,20 +375,44 @@ def _bwd_call(q, k, v, o, lse, do, *, t_real: int, block_q: int, block_k: int):
     # CPU grad tests outside shard_map still cover its math.
     interp_vma = _interpret() and getattr(jax.typeof(q), "vma", None)
     if num_qb == 1 and num_kb == 1 and not interp_vma:
-        spec_td = pl.BlockSpec((None, t_pad, d), lambda b: (b, 0, 0))
-        spec_t1 = pl.BlockSpec((None, t_pad, 1), lambda b: (b, 0, 0))
-        return pl.pallas_call(
-            functools.partial(_bwd_fused_kernel, scale=scale, t_real=t_real),
-            grid=(bh,),
-            in_specs=[spec_td, spec_td, spec_td, spec_td, spec_t1, spec_t1],
-            out_specs=[spec_td, spec_td, spec_td],
+        if group == 1:
+            spec_td = pl.BlockSpec((None, t_pad, d), lambda b: (b, 0, 0))
+            spec_t1 = pl.BlockSpec((None, t_pad, 1), lambda b: (b, 0, 0))
+            return pl.pallas_call(
+                functools.partial(_bwd_fused_kernel, scale=scale,
+                                  t_real=t_real),
+                grid=(bh,),
+                in_specs=[spec_td, spec_td, spec_td, spec_td, spec_t1,
+                          spec_t1],
+                out_specs=[spec_td, spec_td, spec_td],
+                out_shape=[_out_struct((bh, t_pad, d), q.dtype, q),
+                           _out_struct((bh, t_pad, d), k.dtype, q),
+                           _out_struct((bh, t_pad, d), v.dtype, q)],
+                compiler_params=pltpu.CompilerParams(
+                    dimension_semantics=("parallel",)),
+                interpret=_interpret(),
+            )(q, k, v, do, lse, delta)
+        q_td = pl.BlockSpec((None, t_pad, d),
+                            lambda b, g: (_q_row(b, g, hq, hkv), 0, 0))
+        q_t1 = pl.BlockSpec((None, t_pad, 1),
+                            lambda b, g: (_q_row(b, g, hq, hkv), 0, 0))
+        kv_td = pl.BlockSpec((None, t_pad, d), lambda b, g: (b, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_gqa_kernel, scale=scale,
+                              t_real=t_real, group=group),
+            grid=(bhkv, group),
+            in_specs=[q_td, kv_td, kv_td, q_td, q_t1, q_t1],
+            out_specs=[q_td, kv_td, kv_td],
             out_shape=[_out_struct((bh, t_pad, d), q.dtype, q),
-                       _out_struct((bh, t_pad, d), k.dtype, q),
-                       _out_struct((bh, t_pad, d), v.dtype, q)],
+                       _out_struct((bhkv, t_pad, d), k.dtype, q),
+                       _out_struct((bhkv, t_pad, d), v.dtype, q)],
+            scratch_shapes=[pltpu.VMEM((t_pad, d), jnp.float32),
+                            pltpu.VMEM((t_pad, d), jnp.float32)],
             compiler_params=pltpu.CompilerParams(
-                dimension_semantics=("parallel",)),
+                dimension_semantics=("parallel", "arbitrary")),
             interpret=_interpret(),
         )(q, k, v, do, lse, delta)
+        return dq, dk, dv
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, t_real=t_real,
@@ -322,8 +420,8 @@ def _bwd_call(q, k, v, o, lse, do, *, t_real: int, block_q: int, block_k: int):
         grid=(bh, num_qb, num_kb),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (kv(b), j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -336,25 +434,34 @@ def _bwd_call(q, k, v, o, lse, do, *, t_real: int, block_q: int, block_k: int):
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
+    # dk/dv: grid dim 2 runs (group x num_qb) sequential steps per kv block;
+    # the index maps pick query head `hk*group + g` at q-block `qi`.
+    qrow = lambda b, gq: _q_row(b, gq // num_qb, hq, hkv)
+    qblk = lambda gq: gq % num_qb
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, t_real=t_real,
-                          block_q=block_q, block_k=block_k, num_qb=num_qb),
-        grid=(bh, num_kb, num_qb),
+                          block_q=block_q, block_k=block_k, num_qb=num_qb,
+                          group=group),
+        grid=(bhkv, num_kb, group * num_qb),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, gq: (qrow(b, gq), qblk(gq), 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, gq: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, gq: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, gq: (qrow(b, gq), qblk(gq), 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, gq: (qrow(b, gq), qblk(gq), 0)),
+            pl.BlockSpec((1, block_q, 1),
+                         lambda b, j, gq: (qrow(b, gq), qblk(gq), 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, gq: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, gq: (b, j, 0)),
         ],
         out_shape=[
-            _out_struct((bh, t_pad, d), k.dtype, q),
-            _out_struct((bh, t_pad, d), v.dtype, q),
+            _out_struct((bhkv, t_pad, d), k.dtype, q),
+            _out_struct((bhkv, t_pad, d), v.dtype, q),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -373,7 +480,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     block_k: int = DEFAULT_BLOCK_K,
                     bwd_block_q: int = None,
                     bwd_block_k: int = None) -> jax.Array:
-    """Causal flash attention. q, k, v: (b, heads, t, head_dim).
+    """Causal flash attention. q: (b, heads, t, head_dim); k, v may carry
+    FEWER heads (b, kv_heads, t, head_dim) with heads % kv_heads == 0 —
+    grouped-query attention routed inside the kernels (no K/V repeat in HBM).
 
     Drop-in replacement for `causal_attention_xla`
     (`/root/reference/models/model.py:73-77` semantics). Sequence length is
@@ -382,6 +491,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     independently of the forward (default: the swept DEFAULT_BWD_* values).
     """
     b, h, t, d = q.shape
+    hkv = k.shape[1]
+    if h % hkv or v.shape[1] != hkv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads "
+                         f"{k.shape[1]}/{v.shape[1]}")
     if bwd_block_q is None:
         bwd_block_q = DEFAULT_BWD_BLOCK_Q
     if bwd_block_k is None:
@@ -405,27 +518,30 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     bbk = min(bwd_block_k, pow2)
     t_pad = _round_up(t, max(bq, bk, bbq, bbk))
 
-    def prep(x):
-        x = x.reshape(b * h, t, d)
+    def prep(x, nh):
+        x = x.reshape(b * nh, t, d)
         if t_pad != t:
             x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
         return x
 
-    o = _flash_with_t(prep(q), prep(k), prep(v), t, bq, bk, bbq, bbk)
+    o = _flash_with_t(prep(q, h), prep(k, hkv), prep(v, hkv), t,
+                      bq, bk, bbq, bbk, h, hkv)
     return o[:, :t, :].reshape(b, h, t, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_with_t(q, k, v, t_real: int, block_q: int, block_k: int,
-                  bwd_block_q: int, bwd_block_k: int):
-    o, _ = _fwd_call(q, k, v, t_real=t_real, block_q=block_q, block_k=block_k)
+                  bwd_block_q: int, bwd_block_k: int, hq: int = 1,
+                  hkv: int = 1):
+    o, _ = _fwd_call(q, k, v, t_real=t_real, block_q=block_q,
+                     block_k=block_k, hq=hq, hkv=hkv)
     return o
 
 
 def _flash_with_t_fwd(q, k, v, t_real, block_q, block_k,
-                      bwd_block_q, bwd_block_k):
+                      bwd_block_q, bwd_block_k, hq, hkv):
     o, lse = _fwd_call(q, k, v, t_real=t_real,
-                       block_q=block_q, block_k=block_k)
+                       block_q=block_q, block_k=block_k, hq=hq, hkv=hkv)
     # Name the kernel outputs so remat policies can pin them: under
     # `Transformer(remat="dots")` the checkpoint_dots policy saves only
     # dot_general outputs, and without these tags the backward pass would
@@ -436,10 +552,305 @@ def _flash_with_t_fwd(q, k, v, t_real, block_q, block_k,
 
 
 def _flash_with_t_bwd(t_real, block_q, block_k, bwd_block_q, bwd_block_k,
-                      res, do):
+                      hq, hkv, res, do):
     q, k, v, o, lse = res
     return _bwd_call(q, k, v, o, lse, do, t_real=t_real,
-                     block_q=bwd_block_q, block_k=bwd_block_k)
+                     block_q=bwd_block_q, block_k=bwd_block_k,
+                     hq=hq, hkv=hkv)
 
 
 _flash_with_t.defvjp(_flash_with_t_fwd, _flash_with_t_bwd)
+
+
+# ------------------------------------------------- positional block kernel
+#
+# Building block for ring attention (ops/ring_attention.py): one
+# (Q-chunk, KV-chunk) pair where causality is decided by the GLOBAL token
+# positions carried around the cp ring, not by a static triangular mask.
+# Returns normalized per-block output plus the block's row logsumexp so the
+# caller can combine blocks with the online-softmax recurrence
+#     lse' = logaddexp(lse_a, lse_b);  o' = o_a*e^(lse_a-lse') + o_b*e^(...)
+# The custom VJP therefore takes BOTH cotangents (do, dlse): the extra
+# dlse term enters ds as p * dlse (d lse / d s_ij = p_ij), the rest is the
+# standard flash-attention-2 backward. Dead rows (no visible kv in this
+# block) emit lse = MASK, so their combine weight underflows to exactly 0
+# and both their cotangents arrive as zeros.
+
+_QPOS_PAD = -(2 ** 30)  # padded q rows see nothing
+_KPOS_PAD = 2 ** 30     # padded kv cols are seen by nothing
+
+
+def _pos_fwd_kernel(q_ref, k_ref, v_ref, qp_ref, kp_ref, o_ref, lse_ref,
+                    acc_ref, m_ref, l_ref, *, scale: float, num_kb: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, MASK)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale           # (bq, bk)
+    live = qp_ref[0][:, None] >= kp_ref[0][None, :]
+    s = jnp.where(live, s, MASK)
+
+    m_prev = m_ref[:]
+    l_prev = l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # clamp: for all-dead rows m_new stays MASK; exp(MASK - MASK) = 1 would
+    # resurrect masked entries, so guard the subtraction
+    p = jnp.where(live, jnp.exp(s - jnp.maximum(m_new, MASK / 2)), 0.0)
+    alpha = jnp.exp(m_prev - jnp.maximum(m_new, MASK / 2))
+    alpha = jnp.where(m_prev <= MASK / 2, 0.0, alpha)
+    l_ref[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[:] = m_new
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    acc_ref[:] = acc_ref[:] * alpha + pv
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        l = l_ref[:]
+        dead = l == 0.0
+        l_safe = jnp.where(dead, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(dead, MASK, m_ref[:] + jnp.log(l_safe))
+
+
+def _pos_dq_kernel(q_ref, k_ref, v_ref, qp_ref, kp_ref, do_ref, lse_ref,
+                   delta_ref, dlse_ref, dq_ref, dq_acc, *, scale: float,
+                   num_kb: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    live = qp_ref[0][:, None] >= kp_ref[0][None, :]
+    # dead rows carry lse = MASK; exp(MASK - MASK) = 1 would fabricate p, so
+    # hard-zero masked entries (their cotangents are exact zeros anyway)
+    p = jnp.where(live, jnp.exp(s - lse_ref[0]), 0.0)
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta_ref[0] + dlse_ref[0]) * scale).astype(q_ref.dtype)
+    dq_acc[:] += jax.lax.dot_general(
+        ds, k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _pos_dkv_kernel(q_ref, k_ref, v_ref, qp_ref, kp_ref, do_ref, lse_ref,
+                    delta_ref, dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale: float, num_qb: int, group: int):
+    gq = pl.program_id(2)
+
+    @pl.when(gq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    st = jax.lax.dot_general(k_ref[0], q_ref[0], (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    live_t = kp_ref[0][:, None] <= qp_ref[0][None, :]        # (bk, bq)
+    pt = jnp.where(live_t, jnp.exp(st - jnp.transpose(lse_ref[0])), 0.0)
+    dv_acc[:] += jax.lax.dot_general(
+        pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dpt = jax.lax.dot_general(
+        v_ref[0], do_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # (bk, bq)
+    dst = (pt * (dpt - jnp.transpose(delta_ref[0])
+                 + jnp.transpose(dlse_ref[0])) * scale).astype(q_ref.dtype)
+    dk_acc[:] += jax.lax.dot_general(
+        dst, q_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(gq == group * num_qb - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _pos_pad(x, t_pad, fill=0):
+    t = x.shape[1]
+    if t_pad == t:
+        return x
+    return jnp.pad(x, ((0, 0), (0, t_pad - t)) + ((0, 0),) * (x.ndim - 2),
+                   constant_values=fill)
+
+
+def block_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    q_pos: jax.Array, kv_pos: jax.Array,
+                    block_q: int = 512, block_k: int = 512):
+    """Position-masked attention over ONE (Q-chunk, KV-chunk) pair.
+
+    q: (b, h, tq, d); k, v: (b, hkv, tk, d) (hkv may divide h — grouped
+    query heads route like `flash_attention`); q_pos: (b, tq) and kv_pos:
+    (b, tk) global token positions (int32). A query attends to every kv
+    with kv_pos <= q_pos. Returns (o, lse): o (b, h, tq, d) in q's dtype,
+    normalized within the block; lse (b, h, tq) f32, MASK for rows with no
+    visible kv here. Differentiable in q/k/v through both outputs.
+    """
+    b, h, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    if h % hkv or v.shape[1] != hkv:
+        raise ValueError(f"q heads {h} must be a multiple of kv heads "
+                         f"{k.shape[1]}/{v.shape[1]}")
+    bq = min(block_q, max(128, 1 << (tq - 1).bit_length()))
+    bk = min(block_k, max(128, 1 << (tk - 1).bit_length()))
+    tq_pad, tk_pad = _round_up(tq, bq), _round_up(tk, bk)
+
+    def prep(x, nh, t_pad):
+        x = x.reshape(b * nh, x.shape[2], d)
+        if t_pad != x.shape[1]:
+            x = jnp.pad(x, ((0, 0), (0, t_pad - x.shape[1]), (0, 0)))
+        return x
+
+    qf = prep(q, h, tq_pad)
+    kf, vf = prep(k, hkv, tk_pad), prep(v, hkv, tk_pad)
+    qp = _pos_pad(q_pos.astype(jnp.int32), tq_pad, _QPOS_PAD)
+    kp = _pos_pad(kv_pos.astype(jnp.int32), tk_pad, _KPOS_PAD)
+    o, lse = _block_attn_vjp(qf, kf, vf, qp, kp, bq, bk, h, hkv)
+    return (o[:, :tq].reshape(b, h, tq, d),
+            lse[:, :tq, 0].reshape(b, h, tq))
+
+
+def _block_calls(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv):
+    bh, tq_pad, d = qf.shape
+    bhkv, tk_pad = kf.shape[0], kf.shape[1]
+    num_qb, num_kb = tq_pad // block_q, tk_pad // block_k
+    scale = 1.0 / math.sqrt(d)
+    kv = lambda bb: _kv_row(bb, hq, hkv)
+    posrow = lambda bb: bb // hq  # q/pos batch row of a flattened q-head row
+    return dict(bh=bh, bhkv=bhkv, tq_pad=tq_pad, tk_pad=tk_pad, d=d,
+                num_qb=num_qb, num_kb=num_kb, scale=scale, kv=kv,
+                posrow=posrow)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _block_attn_vjp(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv):
+    return _block_fwd_call(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv)
+
+
+def _block_fwd_call(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv):
+    c = _block_calls(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv)
+    kvr, posr = c["kv"], c["posrow"]
+    o, lse = pl.pallas_call(
+        functools.partial(_pos_fwd_kernel, scale=c["scale"],
+                          num_kb=c["num_kb"]),
+        grid=(c["bh"], c["num_qb"], c["num_kb"]),
+        in_specs=[
+            pl.BlockSpec((1, block_q, c["d"]), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, c["d"]),
+                         lambda b, i, j: (kvr(b), j, 0)),
+            pl.BlockSpec((1, block_k, c["d"]),
+                         lambda b, i, j: (kvr(b), j, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (posr(b), i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (posr(b), j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, c["d"]), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            _out_struct((c["bh"], c["tq_pad"], c["d"]), qf.dtype, qf),
+            _out_struct((c["bh"], c["tq_pad"], 1), jnp.float32, qf),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, c["d"]), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(qf, kf, vf, qp, kp)
+    return o, lse
+
+
+def _block_attn_vjp_fwd(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv):
+    o, lse = _block_fwd_call(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv)
+    return (o, lse), (qf, kf, vf, qp, kp, o, lse)
+
+
+def _block_attn_vjp_bwd(block_q, block_k, hq, hkv, res, cts):
+    import numpy as np
+
+    qf, kf, vf, qp, kp, o, lse = res
+    do, dlse = cts
+    c = _block_calls(qf, kf, vf, qp, kp, block_q, block_k, hq, hkv)
+    kvr, posr = c["kv"], c["posrow"]
+    group = hq // hkv
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    dlse = dlse.astype(jnp.float32)
+    if dlse.ndim == 2:  # caller may drop the trailing singleton
+        dlse = dlse[..., None]
+
+    q_spec = pl.BlockSpec((1, block_q, c["d"]), lambda b, i, j: (b, i, 0))
+    q1_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, c["d"]),
+                           lambda b, i, j: (kvr(b), j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_pos_dq_kernel, scale=c["scale"],
+                          num_kb=c["num_kb"]),
+        grid=(c["bh"], c["num_qb"], c["num_kb"]),
+        in_specs=[
+            q_spec, kv_spec, kv_spec,
+            pl.BlockSpec((1, block_q), lambda b, i, j: (posr(b), i)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (posr(b), j)),
+            q_spec, q1_spec, q1_spec, q1_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=_out_struct((c["bh"], c["tq_pad"], c["d"]), qf.dtype, qf),
+        scratch_shapes=[pltpu.VMEM((block_q, c["d"]), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(qf, kf, vf, qp, kp, do, lse, delta, dlse)
+
+    num_qb = c["num_qb"]
+    qrow = lambda b, gq: _q_row(b, gq // num_qb, hq, hkv)
+    qblk = lambda gq: gq % num_qb
+    qg_spec = pl.BlockSpec((1, block_q, c["d"]),
+                           lambda b, j, gq: (qrow(b, gq), qblk(gq), 0))
+    qg1_spec = pl.BlockSpec((1, block_q, 1),
+                            lambda b, j, gq: (qrow(b, gq), qblk(gq), 0))
+    kvo_spec = pl.BlockSpec((1, block_k, c["d"]), lambda b, j, gq: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_pos_dkv_kernel, scale=c["scale"], num_qb=num_qb,
+                          group=group),
+        grid=(c["bhkv"], c["num_kb"], group * num_qb),
+        in_specs=[
+            qg_spec, kvo_spec, kvo_spec,
+            pl.BlockSpec((1, block_q),
+                         lambda b, j, gq: (b // hkv, qblk(gq))),
+            pl.BlockSpec((1, block_k), lambda b, j, gq: (b // hkv, j)),
+            qg_spec, qg1_spec, qg1_spec, qg1_spec,
+        ],
+        out_specs=[kvo_spec, kvo_spec],
+        out_shape=[
+            _out_struct((c["bhkv"], c["tk_pad"], c["d"]), kf.dtype, qf),
+            _out_struct((c["bhkv"], c["tk_pad"], c["d"]), vf.dtype, qf),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, c["d"]), jnp.float32),
+                        pltpu.VMEM((block_k, c["d"]), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(qf, kf, vf, qp, kp, do, lse, delta, dlse)
+
+    zero_pos = lambda p: np.zeros(p.shape, jax.dtypes.float0)
+    return dq, dk, dv, zero_pos(qp), zero_pos(kp)
+
+
+_block_attn_vjp.defvjp(_block_attn_vjp_fwd, _block_attn_vjp_bwd)

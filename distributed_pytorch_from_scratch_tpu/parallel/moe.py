@@ -25,10 +25,18 @@ extension that turns the dense SwiGLU sublayer into a top-k routed MoE, with
   tests exploit (routing is sharding-invariant in expectation AND in value
   when no token drops).
 
-* **Dispatch/combine as one-hot einsums** (dense dispatch): `D[s,e,c]`
-  scatters token s to slot (e, c); `W[s,e,c]` carries the top-k gate
-  weight. einsum('sec,sd->ecd') is MXU-friendly and its transpose (the
-  backward) is the mirrored einsum — no sorts, no dynamic shapes.
+* **Dispatch/combine as static-shape scatter/gather**: each (token, k)
+  routing resolves to a flat slot id `e * C + c`; dispatch is one
+  scatter-add into the (E*C, d) expert buffer and combine is one gather
+  back, weighted by the top-k gate values. Memory is O(S*k + E*C*d) —
+  the earlier dense one-hot formulation built (S, E, C) masks, which is
+  O(cf*k*S^2) and could not fit HBM at bench scale (ADVICE r2: ~4.1e9
+  mask elements at b32 x t1000 x E8). Each expert slot receives at most
+  one token (slot positions are a per-expert cumsum), so the scatter has
+  no duplicate-index accumulation and stays bit-deterministic; dropped
+  tokens route to one trash row that is sliced off. The transpose
+  (backward) of scatter-add is a gather and vice versa — no sorts, no
+  dynamic shapes.
 
 Auxiliary losses follow Switch/ST-MoE: load-balance loss
 `E * sum_e(frac_tokens_e * mean_prob_e)` and router z-loss
@@ -121,8 +129,9 @@ class MoEFFN:
         return max(4, c)
 
     def _route(self, logits: jax.Array) -> Tuple[jax.Array, jax.Array, Params]:
-        """(S, E) router logits -> dispatch D (S, E, C), combine W (S, E, C),
-        aux local sums."""
+        """(S, E) router logits -> flat slot ids (S, k) into the (E*C) expert
+        buffer (E*C = trash for dropped tokens), combine weights (S, k), aux
+        local sums."""
         S, E = logits.shape
         C = self._capacity(S)
         probs = jax.nn.softmax(logits, axis=-1)            # (S, E) f32
@@ -141,11 +150,9 @@ class MoEFFN:
         pos_tok = jnp.sum(pos * onehot, axis=-1)            # (S, k)
         keep = (pos_tok < C) & (topv > 0)                   # (S, k)
 
-        d_slots = (jax.nn.one_hot(topi, E, dtype=jnp.float32)[..., None]
-                   * jax.nn.one_hot(pos_tok, C, dtype=jnp.float32)[:, :, None, :]
-                   * keep[..., None, None].astype(jnp.float32))  # (S,k,E,C)
-        D = jnp.sum(d_slots, axis=1)                        # (S, E, C)
-        W = jnp.sum(d_slots * topv[..., None, None], axis=1)
+        # Flat slot id per (token, k): expert-major, trash slot E*C for drops.
+        slots = jnp.where(keep, topi * C + pos_tok, E * C)  # (S, k)
+        weights = jnp.where(keep, topv, 0.0)                # (S, k)
 
         aux = {
             # routed (pre-drop) assignment counts, the Switch f_e numerator
@@ -155,7 +162,7 @@ class MoEFFN:
             "tokens": jnp.asarray(S, jnp.float32),
             "dropped": jnp.sum(1.0 - keep.astype(jnp.float32)),
         }
-        return D, W, aux
+        return slots, weights, aux
 
     # ---- forward (per-shard, inside shard_map) ----
 
@@ -172,12 +179,20 @@ class MoEFFN:
         xf = x.reshape(S, d)
 
         # Router in f32 for a stable softmax; stop-gradient-free (the router
-        # trains through the combine weights W).
+        # trains through the combine weights).
         logits = xf.astype(jnp.float32) @ params["router"]
-        D, W, aux = self._route(logits)
+        slots, weights, aux = self._route(logits)
+        E, C = self.num_experts, self._capacity(S)
 
         xd = xf.astype(compute_dtype)
-        expert_in = jnp.einsum("sec,sd->ecd", D.astype(compute_dtype), xd)
+        # Dispatch: scatter each kept (token, k) copy into its expert slot.
+        # Every slot receives at most one token, plus the trash row E*C that
+        # absorbs drops and is sliced off — deterministic, O(S*k*d) work.
+        xk = jnp.broadcast_to(xd[:, None, :], (S, self.top_k, d))
+        expert_in = (jnp.zeros((E * C + 1, d), compute_dtype)
+                     .at[slots.reshape(-1)]
+                     .add(xk.reshape(S * self.top_k, d), mode="drop")
+                     [: E * C].reshape(E, C, d))
 
         if self.ep_size > 1:
             # (E, C, d) -> (E/ep, ep*C, d): each ep shard receives its own
@@ -203,7 +218,12 @@ class MoEFFN:
             out = lax.all_to_all(out, self.ep_axis,
                                  split_axis=1, concat_axis=0, tiled=True)
 
-        y = jnp.einsum("sec,ecd->sd", W.astype(compute_dtype), out)
+        # Combine: gather each (token, k)'s expert output back (trash row ->
+        # zeros) and sum weighted by the top-k gate values.
+        out_flat = jnp.concatenate(
+            [out.reshape(E * C, d), jnp.zeros((1, d), out.dtype)])
+        picked = out_flat[slots.reshape(-1)].reshape(S, self.top_k, d)
+        y = jnp.sum(picked * weights[..., None].astype(compute_dtype), axis=1)
         return y.reshape(b, t, d), aux
 
 

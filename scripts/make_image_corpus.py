@@ -1,0 +1,141 @@
+"""Zero-egress substitute for the reference recipe's FineWeb shard.
+
+The reference's step 1 downloads a FineWeb parquet shard
+(`/root/reference/recipe.sh:13-19`); this environment has no network egress,
+so the round-3 hardware training run (VERDICT r2 #2) draws its corpus from
+the English prose already present in the image: module/class/function
+docstrings plus .md/.rst documentation files harvested from site-packages.
+
+Everything downstream is byte-identical to the reference pipeline: the same
+<= 2000-char document filter (`preprocess_data.py:27-28`), the same
+shuffle + 99/1 train/validation split (`:14,31`), the same
+`{"train": [str], "validation": [str]}` JSON schema (`:34-41`), consumed by
+the SAME tokenizer-training / pre-tokenization steps.
+
+Usage: python scripts/make_image_corpus.py out.json [--root DIR] [--max_docs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import random
+import re
+import sys
+import tokenize
+
+MAX_CHARS = 2000   # reference filter (preprocess_data.py:27-28)
+MIN_CHARS = 80     # drop one-liner stubs ("Return x.") — too little signal
+WORD_RE = re.compile(r"[A-Za-z]{2,}")
+
+
+def looks_english(text: str) -> bool:
+    """Keep prose, drop parameter tables / ascii art / code dumps."""
+    words = WORD_RE.findall(text)
+    if len(words) < 12:
+        return False
+    letters = sum(len(w) for w in words)
+    return letters / max(len(text), 1) > 0.55
+
+
+def clean(text: str) -> str:
+    # normalise whitespace runs but keep paragraph breaks
+    text = re.sub(r"[ \t]+", " ", text.strip())
+    text = re.sub(r"\n{3,}", "\n\n", text)
+    return text
+
+
+def docstrings_from(path: str):
+    try:
+        with tokenize.open(path) as f:
+            src = f.read()
+        tree = ast.parse(src)
+    except (SyntaxError, UnicodeDecodeError, ValueError, OSError,
+            RecursionError):
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            doc = ast.get_docstring(node)
+            if doc:
+                yield doc
+
+
+def harvest(root: str, max_docs: int, seed: int):
+    docs, seen = [], set()
+
+    def add(text: str):
+        text = clean(text)
+        if not (MIN_CHARS <= len(text) <= MAX_CHARS):
+            # long documents: split on paragraph boundaries like a crawl
+            # would chunk pages, keeping each piece under the filter
+            if len(text) > MAX_CHARS:
+                for para in re.split(r"\n\n+", text):
+                    if MIN_CHARS <= len(para) <= MAX_CHARS:
+                        add(para)
+            return
+        if not looks_english(text):
+            return
+        h = hash(text)
+        if h in seen:
+            return
+        seen.add(h)
+        docs.append(text)
+
+    py_files, doc_files = [], []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "node_modules", "tests",
+                                    "test", ".git")]
+        for fn in filenames:
+            p = os.path.join(dirpath, fn)
+            if fn.endswith(".py"):
+                py_files.append(p)
+            elif fn.endswith((".md", ".rst")) or fn.startswith("LICENSE"):
+                doc_files.append(p)
+    # deterministic order -> deterministic corpus for a given image
+    py_files.sort()
+    doc_files.sort()
+
+    for p in doc_files:
+        try:
+            with io.open(p, encoding="utf-8", errors="ignore") as f:
+                add(f.read())
+        except OSError:
+            continue
+        if len(docs) >= max_docs:
+            return docs
+    for p in py_files:
+        for doc in docstrings_from(p):
+            add(doc)
+            if len(docs) >= max_docs:
+                return docs
+    return docs
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("out")
+    ap.add_argument("--root", default=os.path.dirname(os.__file__))
+    ap.add_argument("--max_docs", type=int, default=400_000)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args(argv)
+
+    docs = harvest(args.root, args.max_docs, args.seed)
+    # reference split semantics: shuffle, 99/1 (preprocess_data.py:14,31)
+    random.Random(args.seed).shuffle(docs)
+    n_val = max(1, len(docs) // 100)
+    data = {"train": docs[n_val:], "validation": docs[:n_val]}
+    with open(args.out, "w") as f:
+        json.dump(data, f)
+    chars = sum(len(d) for d in docs)
+    print(f"wrote {args.out}: {len(data['train'])} train / "
+          f"{len(data['validation'])} validation docs, {chars / 1e6:.1f}M "
+          f"chars from {args.root}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

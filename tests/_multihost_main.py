@@ -1,0 +1,90 @@
+"""Worker for tests/test_multihost.py: one of two cooperating processes.
+
+Each process owns 4 virtual CPU devices; `init_multihost` wires the
+jax.distributed rendezvous (the analogue of the reference's
+`dist.init_process_group('nccl', 'env://')`, `/root/reference/utils.py:19-24`)
+after which `jax.devices()` spans all 8 devices across both processes and
+the ordinary mesh/shard_map code runs unchanged — one dp2 x tp4 train step
+with per-process dp data sharding.
+
+Usage: python tests/_multihost_main.py <process_id> <coordinator_port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    port = int(sys.argv[2])
+
+    from distributed_pytorch_from_scratch_tpu.runtime.mesh import init_multihost
+
+    init_multihost(coordinator=f"localhost:{port}", num_processes=2,
+                   process_id=process_id)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+
+    from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
+                                                      Transformer, make_mesh)
+    from distributed_pytorch_from_scratch_tpu.config import OptimizerConfig
+    from distributed_pytorch_from_scratch_tpu.training.optim import (
+        init_adam_state)
+    from distributed_pytorch_from_scratch_tpu.training.train_step import (
+        build_train_step)
+
+    dp, tp = 2, 4
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    cfg = ModelConfig(attn_dim=64, ffn_dim=128, num_heads=4, num_layers=2,
+                      vocab_size=64, maxlen=32)
+    model = Transformer(cfg, tp_size=tp)
+
+    # params: computed under jit with the global sharding — each process
+    # materialises only its addressable shards (no host broadcast, unlike
+    # the reference's rank-0 weight broadcast, `layers.py:38,83,116`)
+    params = jax.jit(model.init,
+                     out_shardings=model.shardings(mesh))(jax.random.key(0))
+    opt = init_adam_state(params)
+
+    # data: every process holds ITS dp shard only; the global array is
+    # assembled from process-local data (per-process dp data sharding)
+    b, t = 8, 32
+    rng = np.random.RandomState(7)
+    ids_global = rng.randint(0, cfg.vocab_size, size=(b, t)).astype(np.int32)
+    tgt_global = np.roll(ids_global, -1, axis=1)
+    pos_global = np.tile(np.arange(t, dtype=np.int32)[None, :], (b, 1))
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_sharding = NamedSharding(mesh, P(("dp", "ep"), "cp"))
+    rows = b // jax.process_count()
+    lo = process_id * rows
+
+    def dist_array(global_np):
+        return jax.make_array_from_process_local_data(
+            batch_sharding, global_np[lo:lo + rows])
+
+    step = build_train_step(model, mesh,
+                            OptimizerConfig(lr=1e-3, warmup_steps=2,
+                                            max_steps=10))
+    params, opt, loss = step(params, opt, dist_array(ids_global),
+                             dist_array(tgt_global), dist_array(pos_global))
+    loss = float(jax.block_until_ready(loss))
+    assert np.isfinite(loss), loss
+    print(f"MULTIHOST-OK process={process_id} loss={loss:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

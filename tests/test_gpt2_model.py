@@ -179,3 +179,42 @@ def test_cli_family_gpt2_rejects_cp():
         train_mod.train(train_mod.get_train_args(
             ["--family", "gpt2", "--cp_size", "2", "--data_path", "x.json",
              "--max_steps", "1"]))
+
+
+def test_gpt2_kv_decode_matches_forward_argmax():
+    """The generic KV-cache decoder on the gpt2 family (learned positions,
+    LayerNorm, gelu MLP, tied head) == greedy over the full forward
+    (VERDICT r2 #6: gpt2 used to be forced onto the O(t^2) recompute
+    path)."""
+    from distributed_pytorch_from_scratch_tpu.models.decode import (
+        GreedyDecoder)
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = GPT2Transformer(CFG, tp_size=2)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    fwd = model.make_forward(mesh)
+
+    prompt = [1, 5, 9, 13]
+    buf_len = 12
+    dec = GreedyDecoder(model, mesh, buf_len)
+    gen = dec.decode_batch(params, [prompt], eos_id=-1,  # no EOS: run to cap
+                           max_total_len=buf_len)[0]
+
+    ids = list(prompt)
+    while len(ids) < buf_len:
+        buf = jnp.asarray([ids + [0] * (buf_len - len(ids))])
+        pos = jnp.tile(jnp.arange(buf_len)[None, :], (1, 1))
+        logits = fwd(params, buf, pos)[0, len(ids) - 1, : CFG.vocab_size]
+        ids.append(int(jnp.argmax(logits)))
+    assert gen == ids[len(prompt):], (gen, ids[len(prompt):])
+
+
+def test_gpt2_decoder_rejects_overlong_buffer():
+    from distributed_pytorch_from_scratch_tpu.models.decode import (
+        GreedyDecoder)
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = GPT2Transformer(CFG, tp_size=2)
+    with pytest.raises(ValueError, match="learned position table"):
+        GreedyDecoder(model, mesh, buf_len=CFG.maxlen + 1)

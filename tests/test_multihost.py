@@ -1,0 +1,55 @@
+"""Multi-host (multi-process) training proof — VERDICT r2 #7.
+
+Spawns TWO local processes that rendezvous through
+`runtime.mesh.init_multihost` (`jax.distributed.initialize` underneath — the
+DCN analogue of the reference's NCCL env:// rendezvous,
+`/root/reference/utils.py:19-24`), each owning 4 virtual CPU devices, and
+runs ONE dp2 x tp4 train step with per-process dp data sharding
+(`jax.make_array_from_process_local_data`). Both processes must report the
+identical finite loss: the cross-process psum really ran.
+"""
+
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_train_step():
+    script = os.path.join(os.path.dirname(__file__), "_multihost_main.py")
+    repo = os.path.dirname(os.path.dirname(script))
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # workers set their own device count
+    procs = [subprocess.Popen(
+        [sys.executable, script, str(pid), str(port)],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"stdout:\n{out}\nstderr:\n{err}"
+        outs.append(out)
+
+    losses = []
+    for pid, out in enumerate(outs):
+        m = re.search(rf"MULTIHOST-OK process={pid} loss=([0-9.]+)", out)
+        assert m, out
+        losses.append(float(m.group(1)))
+    assert losses[0] == losses[1], losses

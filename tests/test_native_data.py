@@ -176,3 +176,61 @@ def test_pre_tokenize_added_token_text_falls_back(tmp_path):
     with pytest.raises(ValueError, match="added-token"):
         pre_tokenize(str(inp), str(tmp_path / "n.json"), REF_TOK,
                      backend="native")
+
+
+def test_dataloader_native_overlong_rows_and_dynamic_width(tmp_path):
+    """ADVICE r2: cover the indexed fast path's cap-truncation branch (rows
+    LONGER than maxlen-1) and the pad_to=None dynamic-width branch, against
+    the numpy backend byte-for-byte."""
+    from distributed_pytorch_from_scratch_tpu.data.dataset import (DataLoader,
+                                                                   TokenDataset)
+    rng = random.Random(3)
+    # rows straddle the cap: maxlen=16 -> cap 15, rows up to 40 tokens
+    data = {"train": [[rng.randrange(3, 1000)
+                       for _ in range(rng.randrange(1, 41))]
+                      for _ in range(24)],
+            "validation": [[4, 5, 6]],
+            "special_ids": {"<BOS>": 0, "<EOS>": 1, "<UNK>": 2},
+            "vocab_size": 1024}
+    p = tmp_path / "tokens.json"
+    p.write_text(json.dumps(data))
+
+    def mk(backend, pad_to):
+        # direct DataLoader construction (get_dataloader always sets pad_to)
+        return DataLoader(TokenDataset(str(p), "train", maxlen=16),
+                          batch_size=4, shuffle=True, seed=5,
+                          pad_to=pad_to, backend=backend)
+
+    for pad_to in (None, 16):
+        batches = list(zip(mk("native", pad_to).epoch(0),
+                           mk("numpy", pad_to).epoch(0)))
+        assert batches
+        saw_truncated = False
+        for a, b in batches:
+            for k in ("input_ids", "target_ids", "position_ids"):
+                np.testing.assert_array_equal(a[k], b[k],
+                                              err_msg=f"{k} pad_to={pad_to}")
+            assert a["input_ids"].shape[1] <= 16
+            # a truncated row carries cap tokens + EOS = cap+1 live targets
+            saw_truncated |= bool(
+                (np.sum(a["target_ids"] != -1, axis=1) == 16).any())
+        assert saw_truncated, "test data should exercise the cap branch"
+
+
+def test_dataloader_native_undersized_pad_raises(tmp_path):
+    """An undersized pad_to must raise on BOTH backends (the C++ clamp would
+    otherwise silently truncate — ADVICE r2)."""
+    from distributed_pytorch_from_scratch_tpu.data.dataset import (DataLoader,
+                                                                   TokenDataset)
+    data = {"train": [[5] * 20 for _ in range(8)],
+            "validation": [[4, 5, 6]],
+            "special_ids": {"<BOS>": 0, "<EOS>": 1, "<UNK>": 2},
+            "vocab_size": 1024}
+    p = tmp_path / "tokens.json"
+    p.write_text(json.dumps(data))
+    for backend in ("native", "numpy"):
+        dl = DataLoader(TokenDataset(str(p), "train", maxlen=64),
+                        batch_size=4, shuffle=False, pad_to=10,
+                        backend=backend)
+        with pytest.raises(AssertionError):
+            next(iter(dl.epoch(0)))

@@ -158,10 +158,10 @@ def test_pp_checkpoint_resume(tmp_path):
 def test_validation_errors():
     with pytest.raises(ValueError, match="divisible"):
         Transformer(CFG, pp_size=3)  # 4 layers % 3 != 0
-    with pytest.raises(ValueError, match="MoE"):
-        Transformer(ModelConfig(num_layers=4, num_experts=4), pp_size=2)
-    with pytest.raises(ValueError, match="sequence_parallel"):
-        Transformer(CFG, pp_size=2, sequence_parallel=True)
+    # pp + MoE and pp + sequence_parallel are SUPPORTED since round 3
+    # (VERDICT r2 #4) — construction must succeed
+    Transformer(ModelConfig(num_layers=4, num_experts=4), pp_size=2)
+    Transformer(CFG, pp_size=2, sequence_parallel=True)
     with pytest.raises(ValueError, match="bubbles"):
         Transformer(CFG, pp_size=4, pp_microbatches=2)
     # local batch not divisible by microbatches -> runtime error
@@ -172,3 +172,110 @@ def test_validation_errors():
     ids, tgt, pos = make_batch(jax.random.key(1), batch=4)
     with pytest.raises(ValueError, match="not divisible"):
         model.make_loss(mesh)(params, ids, tgt, pos)
+
+
+# ---- composability matrix closure (VERDICT r2 #4): pp x {MoE, SP} ----
+
+MOE_CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=4,
+                      vocab_size=96, maxlen=64, num_experts=4, moe_top_k=2,
+                      moe_capacity_factor=8.0)  # generous: zero drops
+
+
+@pytest.mark.parametrize("name,axes,kw", [
+    ("pp2_moe", dict(pp=2), dict(pp_size=2)),
+    ("pp2ep2tp2_moe", dict(pp=2, ep=2, tp=2),
+     dict(pp_size=2, ep_size=2, tp_size=2, pp_microbatches=2)),
+])
+def test_pipeline_moe_matches_single_device(name, axes, kw):
+    """MoE models pipeline: router aux sums ride the schedule carry and the
+    aux losses match the 1-device run exactly (no drops at cf=8)."""
+    key = jax.random.key(0)
+    ids, tgt, pos = make_batch(jax.random.key(2))
+
+    ref = Transformer(MOE_CFG)
+    mesh1 = make_mesh(MeshConfig())
+    params = ref.init(key)
+    l_ref, g_ref = jax.value_and_grad(ref.make_loss(mesh1))(
+        params, ids, tgt, pos)
+
+    model = Transformer(MOE_CFG, **kw)
+    mesh = make_mesh(MeshConfig(**axes))
+    sp = jax.device_put(params, model.shardings(mesh))
+    l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(sp, ids, tgt, pos)
+
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,axes,kw", [
+    ("pp2_sp", dict(pp=2, tp=2),
+     dict(pp_size=2, tp_size=2, sequence_parallel=True)),
+    ("dp2pp2tp2_sp", dict(dp=2, pp=2, tp=2),
+     dict(pp_size=2, tp_size=2, sequence_parallel=True, pp_microbatches=4)),
+])
+def test_pipeline_sequence_parallel_matches_single_device(name, axes, kw):
+    """Megatron SP composes with the pipeline: the step carry is the
+    (mb, t/tp, d) seq-sharded activation (tp-varying vma)."""
+    key = jax.random.key(0)
+    ids, tgt, pos = make_batch(jax.random.key(3))
+
+    ref = Transformer(CFG)
+    mesh1 = make_mesh(MeshConfig())
+    params = ref.init(key)
+    l_ref, g_ref = jax.value_and_grad(ref.make_loss(mesh1))(
+        params, ids, tgt, pos)
+
+    model = Transformer(CFG, **kw)
+    mesh = make_mesh(MeshConfig(**axes))
+    sp = jax.device_put(params, model.shardings(mesh))
+    l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(sp, ids, tgt, pos)
+
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_nondivisible_batch_falls_back_to_masked_head():
+    """batch 6 with pp 2, microbatches 3: b % pp == 0 here would be 0 —
+    use b=6, M=3, pp=2 -> b%pp=0... pick M=3, pp=3, b=6 -> chunks of 2;
+    instead force the fallback with b=10, pp=4, M=5 (10 % 4 != 0)."""
+    ids, tgt, pos = make_batch(jax.random.key(4), batch=10)
+    ref = Transformer(CFG)
+    mesh1 = make_mesh(MeshConfig())
+    params = ref.init(jax.random.key(0))
+    l_ref = ref.make_loss(mesh1)(params, ids, tgt, pos)
+
+    model = Transformer(CFG, pp_size=4, pp_microbatches=5)
+    mesh = make_mesh(MeshConfig(pp=4))
+    sp = jax.device_put(params, model.shardings(mesh))
+    l_sh = model.make_loss(mesh)(sp, ids, tgt, pos)
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+
+
+def test_pipeline_remat_steps_matches():
+    """pp_remat_steps=True (the 1F1B-style memory option) is numerically
+    invisible."""
+    ids, tgt, pos = make_batch(jax.random.key(5))
+    ref = Transformer(CFG)
+    mesh1 = make_mesh(MeshConfig())
+    params = ref.init(jax.random.key(0))
+    l_ref, g_ref = jax.value_and_grad(ref.make_loss(mesh1))(
+        params, ids, tgt, pos)
+
+    model = Transformer(CFG, pp_size=2, pp_microbatches=4,
+                        pp_remat_steps=True)
+    mesh = make_mesh(MeshConfig(pp=2))
+    sp = jax.device_put(params, model.shardings(mesh))
+    l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(sp, ids, tgt, pos)
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_pp_microbatches_without_pp_raises():
+    with pytest.raises(ValueError, match="pp_microbatches requires"):
+        Transformer(CFG, pp_microbatches=4)

@@ -21,6 +21,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from distributed_pytorch_from_scratch_tpu.data.tokenizer import (pre_tokenize,
                                                                  train_bpe)
 from distributed_pytorch_from_scratch_tpu.training.checkpoint import (

@@ -9,6 +9,9 @@ shapes an 8-device mesh cannot express.
 import os
 import subprocess
 import sys
+import pytest
+
+pytestmark = pytest.mark.slow
 
 
 def test_wide_mesh_16_devices():
