@@ -134,36 +134,14 @@ def _pad_batch(batch, rows: int):
     }
 
 
-def build_doc_loss(model, mesh):
-    """Jitted per-DOCUMENT mean CE: (params, ids, tgt, pos) ->
-    ((b,) doc means, (b,) real-row mask).
-
-    Working per document makes the validation average exactly independent
-    of --batch_size: every document's token-mean weighs equally, which is
-    what the reference's pinned bs=1 sweep computes (`test.py:58-80` with
-    `:105`), so bs=8 reports the same number bs=1 does — just 8x fewer
-    dispatches. Padding rows (all IGNORE_INDEX) are excluded via the mask.
-    """
-    fwd = model.make_forward(mesh)
-
-    def doc_means(params, ids, tgt, pos):
-        logits = fwd(params, ids, pos).astype(jnp.float32)
-        valid = tgt != IGNORE_INDEX
-        safe = jnp.where(valid, tgt, 0)
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        tl = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
-        token_loss = jnp.where(valid, lse - tl, 0.0)
-        cnt = jnp.sum(valid, axis=-1)
-        return (jnp.sum(token_loss, axis=-1) / jnp.maximum(cnt, 1), cnt > 0)
-
-    return jax.jit(doc_means)
-
-
 def calc_val_loss(loss_fn, params, dataloader, batch_rows: int) -> float:
     """Mean of per-document CE means — the reference's bs=1 sweep semantics
-    (`test.py:58-80`) at any batch size, with its sum-of-means /
-    len(dataset) bug (`test.py:80`) fixed by dividing by the real document
-    count."""
+    (`test.py:58-80`) at any batch size (every document's token-mean weighs
+    equally, so --batch_size only changes dispatch count, not the number),
+    with its sum-of-means / len(dataset) bug (`test.py:80`) fixed by
+    dividing by the real document count. `loss_fn` = `model.make_doc_loss`:
+    the sweep rides the same vocab-parallel CE as training — no (b, t, V)
+    logits gather."""
     total, docs = 0.0, 0
     for batch in dataloader.epoch(0):
         batch = _pad_batch(batch, batch_rows)
@@ -310,19 +288,22 @@ def evaluate(args: argparse.Namespace) -> dict:
     # decoding runs the cp=1 path on the same params (models/decode.py),
     # with its batch replicated over dp/cp.
     if args.family == "gpt2":
-        if args.cp_size > 1 or cfg.num_experts:
-            raise SystemExit("--family gpt2 supports dp x tp only "
-                             "(no --cp_size/--num_experts)")
+        if cfg.num_experts:
+            raise SystemExit("--family gpt2 is dense (MoE is a llama-family "
+                             "feature; no --num_experts)")
         from .models.gpt2 import GPT2Transformer
-        model_val = GPT2Transformer(cfg, tp_size=args.tp_size)
-        model = model_val
+        model_val = GPT2Transformer(cfg, tp_size=args.tp_size,
+                                    cp_size=args.cp_size,
+                                    cp_layout=args.cp_layout)
+        # decoding always runs the cp=1 path on the same params, like llama
+        model = GPT2Transformer(cfg, tp_size=args.tp_size)
     else:
         model_val = Transformer(cfg, tp_size=args.tp_size,
                                 cp_size=args.cp_size,
                                 cp_layout=args.cp_layout)
         model = Transformer(cfg, tp_size=args.tp_size)
     template = model.init(jax.random.key(args.random_seed))
-    loss_fn = build_doc_loss(model_val, mesh)
+    loss_fn = model_val.make_doc_loss(mesh)
 
     ckpts = list_checkpoints(args.ckpt_dir, rank=0)
     if not ckpts:

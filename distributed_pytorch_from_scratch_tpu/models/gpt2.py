@@ -29,8 +29,10 @@ Design notes:
   column-parallel (`gather_output=False`), wo + proj row-parallel
   (`split_input=False`) — one all-reduce per sublayer per direction.
 
-* Context/sequence parallelism are not wired for this family (cp_size is
-  fixed at 1); attention runs the same flash/XLA kernels.
+* Context parallelism (ring / Ulysses over 'cp') and Megatron sequence
+  parallelism over 'tp' compose with this family exactly like the llama
+  one — same collectives, no RoPE (positions are learned and enter at the
+  embedding, so the cp shards just index their position slice).
 """
 
 from __future__ import annotations
@@ -45,11 +47,14 @@ from jax import lax
 
 from ..config import ModelConfig, resolve_dtype
 from ..ops.attention import causal_attention
+from ..ops.collectives import gather_from
+from ..ops.ring_attention import ring_attention, ulysses_attention
 from ..parallel.embedding import VocabParallelEmbedding
 from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
 from ..parallel.norm import LayerNorm
 from ..runtime.prng import fold
-from .transformer import NEG_INF, Transformer, remat_wrap
+from .transformer import (NEG_INF, Transformer, remat_wrap,
+                          validate_cp)
 
 Params = Dict[str, Any]
 
@@ -64,9 +69,11 @@ class GPT2Transformer:
     tp_size: int = 1
     attn_impl: str = "auto"
     remat: "bool | str" = True
-    # static attrs Transformer's borrowed methods consult; this family is
-    # dp x tp only
+    # context parallelism over 'cp' and Megatron SP over 'tp', same
+    # semantics as the llama family; pp stays 1 (the pipeline's microbatch
+    # machinery lives in Transformer._pipeline_layers — llama only)
     cp_size: int = 1
+    cp_impl: str = "ring"
     cp_layout: str = "contiguous"
     sequence_parallel: bool = False
     pp_size: int = 1
@@ -86,6 +93,7 @@ class GPT2Transformer:
         if cfg.kv_heads != cfg.num_heads:
             raise ValueError("grouped-query attention (num_kv_heads) is a "
                              "llama-family feature; the gpt2 family is MHA")
+        validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
 
     # ---- static properties ----
 
@@ -174,25 +182,47 @@ class GPT2Transformer:
 
     # ---- per-shard forward (inside shard_map) ----
 
-    def _layer_body(self, x: jax.Array, lp: Params, dtype) -> jax.Array:
+    def _layer_body(self, x: jax.Array, lp: Params, pos: jax.Array,
+                    dtype) -> jax.Array:
         m = self._mods
         h = self.cfg.head_dim
-        b, t, _ = x.shape
+        # sequence parallelism: x is (b, t/tp, d) between sublayers; the
+        # norm output is gathered ONCE per sublayer and shared by the
+        # projections, row-linear outputs reduce-scatter back (the same
+        # Megatron SP pattern as Transformer._layer_body)
+        sp = self.sequence_parallel
+        maybe_gather = ((lambda z: gather_from(z, "tp", tiled_axis=-2))
+                        if sp else (lambda z: z))
+        in_layout = "gathered" if sp else "replicated"
+        out_layout = "seq_sharded" if sp else "replicated"
+        b = x.shape[0]
+        t = pos.shape[1]  # full (cp-local) sequence length, not x.shape[1]
 
-        y = m["ln1"].apply(lp["ln1"], x)
-        q = m["wq"].apply(lp["wq"], y, dtype)
-        k = m["wk"].apply(lp["wk"], y, dtype)
-        v = m["wv"].apply(lp["wv"], y, dtype)
+        y = maybe_gather(m["ln1"].apply(lp["ln1"], x))
+        q = m["wq"].apply(lp["wq"], y, dtype, input_layout=in_layout)
+        k = m["wk"].apply(lp["wk"], y, dtype, input_layout=in_layout)
+        v = m["wv"].apply(lp["wv"], y, dtype, input_layout=in_layout)
         split = lambda z: z.reshape(b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
-        o = causal_attention(split(q), split(k), split(v), impl=self.attn_impl)
+        q, k, v = split(q), split(k), split(v)
+        if self.cp_size > 1:
+            if self.cp_impl == "ring":
+                o = ring_attention(q, k, v, pos, axis="cp",
+                                   impl=self.attn_impl)
+            else:
+                o = ulysses_attention(q, k, v, axis="cp", impl=self.attn_impl)
+        else:
+            o = causal_attention(q, k, v, impl=self.attn_impl)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, self.num_local_heads * h)
-        x = x + m["wo"].apply(lp["wo"], o, dtype)
+        x = x + m["wo"].apply(lp["wo"], o, dtype, output_layout=out_layout)
 
-        y = m["ln2"].apply(lp["ln2"], x)
+        y = maybe_gather(m["ln2"].apply(lp["ln2"], x))
         # gelu_new (tanh approximation), like GPT-2
         x = x + m["proj"].apply(lp["proj"],
-                                jax.nn.gelu(m["fc"].apply(lp["fc"], y, dtype),
-                                            approximate=True), dtype)
+                                jax.nn.gelu(m["fc"].apply(
+                                    lp["fc"], y, dtype,
+                                    input_layout=in_layout),
+                                    approximate=True), dtype,
+                                output_layout=out_layout)
         return x
 
     def forward_shard(self, params: Params, input_ids: jax.Array,
@@ -200,22 +230,39 @@ class GPT2Transformer:
         """(b_local, t) ids -> (b_local, t, vocab_padded / tp) LOCAL logits —
         the same per-shard contract as `Transformer.forward_shard`."""
         dtype = resolve_dtype(self.cfg.compute_dtype)
-        x = self.embedding.apply(params["embedding"], input_ids)
-        pos = jnp.take(params["pos_embedding"]["weight"], position_ids,
-                       axis=0, mode="clip")
-        x = (x + pos).astype(dtype)
+        sp = self.sequence_parallel
+        if sp and input_ids.shape[1] % self.tp_size != 0:
+            raise ValueError(
+                f"sequence_parallel needs the (cp-local) sequence length "
+                f"{input_ids.shape[1]} divisible by tp_size {self.tp_size}")
+        x = self.embedding.apply(params["embedding"], input_ids,
+                                 output_layout="seq_sharded" if sp
+                                 else "replicated")
+        pos_emb = jnp.take(params["pos_embedding"]["weight"], position_ids,
+                           axis=0, mode="clip")
+        if sp:
+            # embedding output is seq-sharded; slice the position rows the
+            # same way before the add
+            tl = pos_emb.shape[1] // self.tp_size
+            pos_emb = lax.dynamic_slice_in_dim(
+                pos_emb, lax.axis_index("tp") * tl, tl, axis=1)
+        x = (x + pos_emb).astype(dtype)
 
-        layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(2,))
+        layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(3,))
 
         def body(carry, lp):
-            return layer_fn(carry, lp, dtype), None
+            return layer_fn(carry, lp, position_ids, dtype), None
 
         x, _ = lax.scan(body, x, params["layers"])
         x = self.final_norm.apply(params["norm"], x)
+        if sp:
+            # the tied head consumes full-sequence activations; the gather's
+            # transpose reduce-scatters the head's input cotangent
+            x = gather_from(x, "tp", tiled_axis=-2)
 
         # tied head: local logits against this shard's embedding rows
         w = params["embedding"]["weight"].astype(dtype)  # (vp/tp, d)
-        logits = x @ w.T                                  # (b, t, vp/tp)
+        logits = x.astype(dtype) @ w.T                    # (b, t, vp/tp)
 
         if self.vocab_padded != self.cfg.vocab_size:
             local_v = self.vocab_padded // self.tp_size
@@ -237,7 +284,10 @@ class GPT2Transformer:
         return self.forward_shard(params, input_ids, position_ids), None
 
     _zigzag = Transformer._zigzag
+    _token_ce = Transformer._token_ce
     loss_shard = Transformer.loss_shard
+    doc_loss_shard = Transformer.doc_loss_shard
     make_forward = Transformer.make_forward
     make_loss = Transformer.make_loss
+    make_doc_loss = Transformer.make_doc_loss
     shardings = Transformer.shardings

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import IGNORE_INDEX, ModelConfig, resolve_dtype
 from ..ops.attention import causal_attention
-from ..ops.collectives import gather_from, reduce_from
+from ..ops.collectives import copy_to, gather_from, reduce_from
 from ..ops.ring_attention import ring_attention, ulysses_attention
 from ..ops.rope import apply_rotary, rope_tables
 from ..parallel.embedding import VocabParallelEmbedding
@@ -54,6 +54,29 @@ from ..runtime.prng import fold
 Params = Dict[str, Any]
 
 NEG_INF = -1e9  # mask value for padded vocab logits
+
+
+def validate_cp(cfg: ModelConfig, tp: int, cp_size: int, cp_impl: str,
+                cp_layout: str) -> None:
+    """Context-parallel construction checks shared by both model families
+    (llama + gpt2): cp_impl/cp_layout membership, Ulysses head
+    divisibility (q AND kv local heads), zigzag-requires-ring."""
+    if cp_impl not in ("ring", "ulysses"):
+        raise ValueError(f"cp_impl must be 'ring' or 'ulysses', got "
+                         f"{cp_impl!r}")
+    if (cp_size > 1 and cp_impl == "ulysses"
+            and ((cfg.num_heads // tp) % cp_size != 0
+                 or (cfg.kv_heads // tp) % cp_size != 0)):
+        raise ValueError(
+            f"ulysses needs local q heads {cfg.num_heads // tp} and kv "
+            f"heads {cfg.kv_heads // tp} divisible by cp_size {cp_size}; "
+            f"use cp_impl='ring'")
+    if cp_layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"cp_layout must be 'contiguous' or 'zigzag', "
+                         f"got {cp_layout!r}")
+    if cp_layout == "zigzag" and cp_impl != "ring":
+        raise ValueError("cp_layout='zigzag' requires cp_impl='ring' "
+                         "(Ulysses assumes rank-order contiguous chunks)")
 
 
 def remat_wrap(layer_fn, remat, static_argnums=()):
@@ -161,22 +184,7 @@ class Transformer:
         if cfg.kv_heads % tp != 0:
             raise ValueError(f"num_kv_heads {cfg.kv_heads} not divisible by "
                              f"tp_size {tp}")
-        if self.cp_impl not in ("ring", "ulysses"):
-            raise ValueError(f"cp_impl must be 'ring' or 'ulysses', got "
-                             f"{self.cp_impl!r}")
-        if (self.cp_size > 1 and self.cp_impl == "ulysses"
-                and ((cfg.num_heads // tp) % self.cp_size != 0
-                     or (cfg.kv_heads // tp) % self.cp_size != 0)):
-            raise ValueError(
-                f"ulysses needs local q heads {cfg.num_heads // tp} and kv "
-                f"heads {cfg.kv_heads // tp} divisible by cp_size "
-                f"{self.cp_size}; use cp_impl='ring'")
-        if self.cp_layout not in ("contiguous", "zigzag"):
-            raise ValueError(f"cp_layout must be 'contiguous' or 'zigzag', "
-                             f"got {self.cp_layout!r}")
-        if self.cp_layout == "zigzag" and self.cp_impl != "ring":
-            raise ValueError("cp_layout='zigzag' requires cp_impl='ring' "
-                             "(Ulysses assumes rank-order contiguous chunks)")
+        validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
         if not cfg.num_experts and self.ep_size > 1:
             raise ValueError("ep_size > 1 requires cfg.num_experts > 0 "
                              "(a dense model has nothing to shard over 'ep'; "
@@ -510,16 +518,10 @@ class Transformer:
             ("tp",) if self.sequence_parallel else ())
 
         def pvary(z):
-            # idempotent: add only the tags z doesn't already carry (router
-            # aux leaves mix constants — invariant — with token-derived
-            # values, and cond branches must agree exactly)
-            have = getattr(jax.typeof(z), "vma", frozenset()) or frozenset()
-            need = tuple(a for a in vary_axes if a not in have)
-            if not need:
-                return z
-            if hasattr(lax, "pcast"):
-                return lax.pcast(z, need, to="varying")
-            return lax.pvary(z, need)
+            # copy_to is the tag-aware (idempotent) varying cast: router aux
+            # leaves mix constants — invariant — with token-derived values,
+            # and cond branches must agree exactly
+            return copy_to(z, vary_axes)
 
         def local_layers(z, c, s_, p_):
             def body(carry, lp):
@@ -601,29 +603,11 @@ class Transformer:
 
     # ---- losses (per-shard, inside shard_map) ----
 
-    def loss_shard(self, params: Params, input_ids: jax.Array,
-                   target_ids: jax.Array, position_ids: jax.Array,
-                   mode: str = "vocab_parallel",
-                   batch_axes: Tuple[str, ...] = ("dp", "ep", "cp")) -> jax.Array:
-        """Mean cross-entropy over non-ignored tokens, global over the mesh.
-
-        f32 loss with ignore-index masking, matching the reference's
-        `F.cross_entropy(logits.float(), ..., ignore_index=-1, 'mean')`
-        (`/root/reference/train.py:101-104`).
-        """
-        # Pipeline head layout: with a pp-divisible batch each stage computes
-        # norm/lm_head/CE on a DISJOINT 1/pp chunk (no duplicated head FLOPs
-        # — VERDICT r2 weak #2c); otherwise every stage sees the broadcast
-        # full batch and the sums are masked to the last stage below.
-        pp_scatter = (self.pp_size > 1
-                      and input_ids.shape[0] % self.pp_size == 0)
-        logits, aux = self._forward_with_aux(
-            params, input_ids, position_ids,
-            head_layout="pp_scatter" if pp_scatter else "replicated")
-        if pp_scatter:
-            chunk = input_ids.shape[0] // self.pp_size
-            target_ids = lax.dynamic_slice_in_dim(
-                target_ids, lax.axis_index("pp") * chunk, chunk, axis=0)
+    def _token_ce(self, logits: jax.Array, target_ids: jax.Array,
+                  mode: str) -> Tuple[jax.Array, jax.Array]:
+        """Per-token CE from the LOCAL vocab-shard logits: (token_loss f32,
+        valid mask), both (..., t). Shared by the training loss and the
+        per-document eval loss."""
         logits = logits.astype(jnp.float32)
         valid = target_ids != IGNORE_INDEX
         tgt = jnp.where(valid, target_ids, 0)
@@ -658,7 +642,32 @@ class Transformer:
             token_loss = lse - tgt_logit
         else:
             raise ValueError(f"unknown loss mode {mode!r}")
+        return token_loss, valid
 
+    def loss_shard(self, params: Params, input_ids: jax.Array,
+                   target_ids: jax.Array, position_ids: jax.Array,
+                   mode: str = "vocab_parallel",
+                   batch_axes: Tuple[str, ...] = ("dp", "ep", "cp")) -> jax.Array:
+        """Mean cross-entropy over non-ignored tokens, global over the mesh.
+
+        f32 loss with ignore-index masking, matching the reference's
+        `F.cross_entropy(logits.float(), ..., ignore_index=-1, 'mean')`
+        (`/root/reference/train.py:101-104`).
+        """
+        # Pipeline head layout: with a pp-divisible batch each stage computes
+        # norm/lm_head/CE on a DISJOINT 1/pp chunk (no duplicated head FLOPs
+        # — VERDICT r2 weak #2c); otherwise every stage sees the broadcast
+        # full batch and the sums are masked to the last stage below.
+        pp_scatter = (self.pp_size > 1
+                      and input_ids.shape[0] % self.pp_size == 0)
+        logits, aux = self._forward_with_aux(
+            params, input_ids, position_ids,
+            head_layout="pp_scatter" if pp_scatter else "replicated")
+        if pp_scatter:
+            chunk = input_ids.shape[0] // self.pp_size
+            target_ids = lax.dynamic_slice_in_dim(
+                target_ids, lax.axis_index("pp") * chunk, chunk, axis=0)
+        token_loss, valid = self._token_ce(logits, target_ids, mode)
         loss_sum = jnp.sum(jnp.where(valid, token_loss, 0.0))
         count = jnp.sum(valid.astype(jnp.float32))
         if self.pp_size > 1:
@@ -745,6 +754,47 @@ class Transformer:
         def zz(params, input_ids, target_ids, position_ids):
             # masked token-mean CE is permutation-invariant: permute all
             # three together, no unpermute needed
+            perm = zigzag_perm(input_ids.shape[1], self.cp_size)
+            return fn(params, input_ids[:, perm], target_ids[:, perm],
+                      position_ids[:, perm])
+
+        return jax.jit(zz)
+
+    def doc_loss_shard(self, params: Params, input_ids: jax.Array,
+                       target_ids: jax.Array, position_ids: jax.Array,
+                       mode: str = "vocab_parallel"):
+        """Per-DOCUMENT mean CE: ((b_local,) means f32, (b_local,) real-row
+        mask). Uses the same vocab-parallel CE as training — no (b, t, V)
+        logits gather. Padding rows (all IGNORE_INDEX) report mask False.
+
+        Eval-only (forward under no grad); pp meshes are not supported here
+        (evaluation runs dp x cp x tp, like the reference's)."""
+        if self.pp_size > 1:
+            raise ValueError("doc_loss runs on a pp=1 eval mesh")
+        logits, _ = self._forward_with_aux(params, input_ids, position_ids)
+        token_loss, valid = self._token_ce(logits, target_ids, mode)
+        # per-row sums over this shard's sequence chunk, then totals over cp
+        row_sum = lax.psum(jnp.sum(jnp.where(valid, token_loss, 0.0), axis=-1),
+                           "cp")
+        row_cnt = lax.psum(jnp.sum(valid.astype(jnp.float32), axis=-1), "cp")
+        return row_sum / jnp.maximum(row_cnt, 1.0), row_cnt > 0
+
+    def make_doc_loss(self, mesh: Mesh, mode: str = "vocab_parallel"):
+        """Jitted per-document eval loss (see doc_loss_shard); the row dim
+        stays sharded over ('dp', 'ep') like the batch."""
+        from ..ops.ring_attention import zigzag_perm
+
+        fn = jax.shard_map(
+            functools.partial(self.doc_loss_shard, mode=mode), mesh=mesh,
+            in_specs=(self.specs(), P(("dp", "ep"), "cp"),
+                      P(("dp", "ep"), "cp"), P(("dp", "ep"), "cp")),
+            out_specs=(P(("dp", "ep")), P(("dp", "ep"))),
+        )
+        if not self._zigzag:
+            return jax.jit(fn)
+
+        def zz(params, input_ids, target_ids, position_ids):
+            # per-document masked means are token-permutation-invariant
             perm = zigzag_perm(input_ids.shape[1], self.cp_size)
             return fn(params, input_ids[:, perm], target_ids[:, perm],
                       position_ids[:, perm])

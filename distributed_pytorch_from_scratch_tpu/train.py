@@ -226,12 +226,12 @@ def train(args: argparse.Namespace) -> dict:
                          f"by dp_size*ep_size "
                          f"{args.dp_size * args.ep_size} (the batch shards "
                          f"over both axes)")
-    if args.family == "gpt2" and (args.cp_size > 1 or args.sequence_parallel
-                                  or args.ep_size > 1 or args.num_experts
+    if args.family == "gpt2" and (args.ep_size > 1 or args.num_experts
                                   or args.pp_size > 1):
-        raise SystemExit("--family gpt2 supports the dp x tp mesh only "
-                         "(no --cp_size/--sequence_parallel/--num_experts/"
-                         "--ep_size/--pp_size)")
+        raise SystemExit("--family gpt2 supports dp x cp x tp (+ "
+                         "--sequence_parallel); MoE and the pipeline are "
+                         "llama-family features "
+                         "(no --num_experts/--ep_size/--pp_size)")
     mesh = make_mesh(mesh_cfg)
 
     dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -254,6 +254,9 @@ def train(args: argparse.Namespace) -> dict:
     if args.family == "gpt2":
         from .models.gpt2 import GPT2Transformer
         model = GPT2Transformer(cfg, tp_size=args.tp_size,
+                                cp_size=args.cp_size, cp_impl=args.cp_impl,
+                                cp_layout=args.cp_layout,
+                                sequence_parallel=args.sequence_parallel,
                                 remat=REMAT_CHOICES[args.remat])
     else:
         model = Transformer(cfg, tp_size=args.tp_size,

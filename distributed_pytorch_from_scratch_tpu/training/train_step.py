@@ -144,5 +144,3 @@ def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
     return _jit_with_zero1(step, model, mesh, zero1, moment_shardings, P())
 
 
-def build_eval_loss(model: Transformer, mesh, loss_mode: str = "vocab_parallel"):
-    return jax.jit(model.make_loss(mesh, mode=loss_mode))
