@@ -45,6 +45,10 @@ def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="45m",
                    choices=["45m", "gpt2-124m", "tiny", "45m-moe8"])
+    p.add_argument("--family", default="llama", choices=["llama", "gpt2"],
+                   help="model family; 'gpt2' benches GPT2Transformer "
+                        "(LayerNorm/GELU/learned positions/tied head) at "
+                        "the chosen preset shape")
     # "dots" saves matmul outputs + the flash kernel's o/lse residuals
     # (models/transformer.py); measured faster than full remat at every
     # config that fits, and the 45M b32xt1000 run fits on a 16G chip.
@@ -87,8 +91,14 @@ def main(argv=None):
         ids, tgt, pos = (jnp.tile(x[None], (spd, 1, 1)) for x in (ids, tgt, pos))
 
     def build(remat, attn_impl):
-        model = Transformer(cfg, tp_size=tp, attn_impl=attn_impl,
-                            remat=REMAT_CHOICES[remat])
+        if args.family == "gpt2":
+            from distributed_pytorch_from_scratch_tpu.models.gpt2 import (
+                GPT2Transformer)
+            model = GPT2Transformer(cfg, tp_size=tp, attn_impl=attn_impl,
+                                    remat=REMAT_CHOICES[remat])
+        else:
+            model = Transformer(cfg, tp_size=tp, attn_impl=attn_impl,
+                                remat=REMAT_CHOICES[remat])
         params = jax.device_put(model.init(jax.random.key(0)),
                                 model.shardings(mesh))
         opt_state = init_adam_state(params)
@@ -150,7 +160,8 @@ def main(argv=None):
     world = args.dp * tp
     tokens_per_sec_per_chip = B * T / step_s / world
 
-    flops_per_step = model_flops_per_step(cfg, B, T)
+    flops_per_step = model_flops_per_step(
+        cfg, B, T, params=params if args.family == "gpt2" else None)
     mfu = flops_per_step / step_s / (chip_peak_flops() * world)
 
     p50 = allreduce_p50_us(mesh, "tp") if tp > 1 else None
@@ -172,7 +183,7 @@ def main(argv=None):
           file=sys.stderr)
 
     print(json.dumps({
-        "metric": (f"tokens/sec/chip ({args.model} GPT, bf16, b{B}xt{T}, "
+        "metric": (f"tokens/sec/chip ({args.model} {args.family}, bf16, b{B}xt{T}, "
                    f"dp={args.dp}, tp={tp}, remat={remat_used}, "
                    f"attn={attn_used}, steps_per_dispatch={spd})"),
         "value": round(tokens_per_sec_per_chip, 1),

@@ -99,6 +99,13 @@ def get_eval_args(argv=None) -> argparse.Namespace:
     g.add_argument("--no_kv_cache", action="store_true",
                    help="use the reference-parity full-recompute decode "
                         "instead of the KV-cache decoder (models/decode.py)")
+    g.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy argmax (reference rule, test.py:149); "
+                        "> 0 samples from softmax(logits/T) (KV-cache "
+                        "decoder only)")
+    g.add_argument("--decode_top_k", type=int, default=0,
+                   help="with --temperature > 0: sample from the k most "
+                        "likely tokens (0 = full distribution)")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -109,7 +116,12 @@ def get_eval_args(argv=None) -> argparse.Namespace:
                         "DOCUMENT means, so the reported loss is exactly "
                         "batch-size independent, and ragged final batches "
                         "are padded with IGNORE_INDEX rows)")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.temperature and args.no_kv_cache:
+        # fail at parse time, not after the multi-checkpoint val sweep
+        p.error("--temperature requires the KV-cache decoder "
+                "(drop --no_kv_cache)")
+    return args
 
 
 def _pad_batch(batch, rows: int):
@@ -184,7 +196,10 @@ def make_greedy_decoder(model: Transformer, mesh, buf_len: int):
 def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
                   bos_id: int, eos_id: int,
                   max_decode_len: int = 128,
-                  use_kv_cache: bool = True) -> List[Tuple[str, str]]:
+                  use_kv_cache: bool = True,
+                  temperature: float = 0.0,
+                  top_k: int = 0,
+                  seed: int = 0) -> List[Tuple[str, str]]:
     texts = [t.strip() for t in prompts]
     encoded = {t: tokenizer.encode(t).ids for t in texts}
     # one fixed buffer for every prompt (single compile); leave room for BOS
@@ -208,10 +223,11 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
         # ONE device dispatch for the whole prompt set: decode_batch handles
         # the mixed prompt lengths (models/decode.py). The reference loops
         # prompts AND tokens (`test.py:141-161`).
-        decoder = GreedyDecoder(model, mesh, buf_len)
+        decoder = GreedyDecoder(model, mesh, buf_len,
+                                temperature=temperature, top_k=top_k)
         gens = decoder.decode_batch(
             params, [[bos_id] + encoded[t] for t in texts], eos_id,
-            max_total_len=max_decode_len + 1)
+            max_total_len=max_decode_len + 1, seed=seed)
         decoded_texts = [tokenizer.decode(encoded[t] + gen).strip()
                          for t, gen in zip(texts, gens)]
     else:
@@ -334,7 +350,9 @@ def evaluate(args: argparse.Namespace) -> dict:
     assert tokenizer.token_to_id(EOS_TOKEN) == eos_id
     decoded = greedy_decode(model, mesh, params, tokenizer, DECODE_PROMPTS,
                             bos_id, eos_id, args.max_decode_len,
-                            use_kv_cache=not args.no_kv_cache)
+                            use_kv_cache=not args.no_kv_cache,
+                            temperature=args.temperature,
+                            top_k=args.decode_top_k, seed=args.random_seed)
     with open(report_path, "a") as f:
         f.write("\n\nInput texts -> Decoded texts\n")
         for prompt, completion in decoded:

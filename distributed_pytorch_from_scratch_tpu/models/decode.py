@@ -201,9 +201,10 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
     return k_new, v_new, _logits_last(model, params, x, dtype)
 
 
-def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
+def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
+                  temperature: float = 0.0, top_k: int = 0):
     """Whole-generation XLA program: jitted
-    (params, buf(b, buf_len), prompt_len, eos_id, max_total_len)
+    (params, buf(b, buf_len), prompt_len, eos_id, max_total_len, key)
       -> (buf with generated tokens written, per-row total length (b,)).
 
     `prompt_len` may be a scalar (all rows share a length) or a (b,) vector
@@ -213,11 +214,17 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
     its own prompt token (recomputing the K/V the prefill already wrote —
     per-position activations under causal attention are context-past-only,
     so the values are identical) until the cursor clears its prompt, after
-    which its argmax tokens are appended like the single-row case.
+    which its sampled tokens are appended like the single-row case.
 
-    Greedy (argmax) decoding; rows that emit EOS stop contributing to their
-    length and are padded with eos_id while other rows finish. One compile
-    serves every prompt (prompt_len/eos/limit are traced)."""
+    `temperature` 0 = greedy argmax (the reference's only decoding rule,
+    `test.py:149`); > 0 samples from softmax(logits / temperature), with
+    `top_k > 0` restricting to the k most likely tokens first — the
+    standard sampling surface the reference lacks. Sampling keys fold in
+    the cursor, so every position draws fresh randomness while staying a
+    pure function of the caller's `key`. Rows that emit EOS stop
+    contributing to their length and are padded with eos_id while other
+    rows finish. One compile serves every prompt (prompt_len/eos/limit are
+    traced; temperature/top_k are build-time constants)."""
     cfg = model.cfg
     dtype = resolve_dtype(cfg.compute_dtype)
     # RoPE tables cover the whole decode buffer even past the model's
@@ -225,8 +232,12 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
     # when buf_len > maxlen — ADVICE r1). Families with learned positions
     # instead hard-cap the buffer (GreedyDecoder validates).
     table_len = max(cfg.maxlen, buf_len)
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or top_k > cfg.vocab_size:
+        raise ValueError(f"top_k must be in [0, vocab_size], got {top_k}")
 
-    def shard_fn(params, buf, prompt_len, eos_id, max_total_len):
+    def shard_fn(params, buf, prompt_len, eos_id, max_total_len, key):
         b, _ = buf.shape
         cos_t = sin_t = None
         if model.uses_rope:
@@ -235,16 +246,29 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
         ks, vs, logits = _prefill(model, params, buf, prompt_len,
                                   cos_t, sin_t, dtype)
 
-        def next_token(logits):
-            # global argmax across the tp vocab shards; pmax of the identical
-            # per-shard result makes it invariant over tp for the buf carry
+        def next_token(logits, cur):
+            # gather the tp vocab shards; every shard then computes the
+            # same choice (same key), and pmax clears the varying tag so
+            # the buf carry stays tp-invariant
             full = gather_from(logits.astype(jnp.float32), "tp")
-            idx = jnp.argmax(full[:, : cfg.vocab_size], axis=-1).astype(jnp.int32)
+            full = full[:, : cfg.vocab_size]
+            if temperature == 0.0:
+                idx = jnp.argmax(full, axis=-1).astype(jnp.int32)
+            else:
+                scaled = full / temperature
+                if top_k:
+                    # kth-largest threshold via top_k, not a full V-sort —
+                    # this runs once per generated token in the fused loop
+                    kth = lax.top_k(scaled, top_k)[0][:, -1][:, None]
+                    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+                idx = jax.random.categorical(
+                    jax.random.fold_in(key, cur), scaled, axis=-1
+                ).astype(jnp.int32)
             return lax.pmax(idx, "tp")
 
         limit = jnp.minimum(max_total_len, buf_len)
-        nxt = next_token(logits)                     # (b,) per-row first token
         cur0 = jnp.min(prompt_len)
+        nxt = next_token(logits, cur0)               # (b,) per-row first token
         done0 = (prompt_len == cur0) & (nxt == eos_id)
         gen0 = jnp.zeros((b,), jnp.int32)
         carry0 = (buf, ks, vs, nxt, done0, gen0, cur0)
@@ -263,7 +287,7 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
             buf = lax.dynamic_update_slice(buf, tok[:, None], (0, cur))
             ck, cv, logits = _decode_one(model, params, ck, cv, tok, cur,
                                          buf_len, cos_t, sin_t, dtype)
-            cand = next_token(logits)
+            cand = next_token(logits, cur + 1)
             # cand is consumed at position cur+1; it counts as a GENERATED
             # token for a row only once the cursor has cleared its prompt
             starts_gen = (cur + 1) >= prompt_len
@@ -275,24 +299,29 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int):
 
     fn = jax.shard_map(
         shard_fn, mesh=mesh,
-        in_specs=(model.specs(), P(None, None), P(None), P(), P()),
+        in_specs=(model.specs(), P(None, None), P(None), P(), P(), P()),
         out_specs=(P(None, None), P(None)))
 
-    def wrapper(params, buf, prompt_len, eos_id, max_total_len):
+    def wrapper(params, buf, prompt_len, eos_id, max_total_len, key):
         prompt_len = jnp.broadcast_to(
             jnp.asarray(prompt_len, jnp.int32), (buf.shape[0],))
-        return fn(params, buf, prompt_len, eos_id, max_total_len)
+        return fn(params, buf, prompt_len, eos_id, max_total_len, key)
 
     return jax.jit(wrapper)
 
 
 class GreedyDecoder:
-    """KV-cache greedy decoder: compile the whole-generation program ONCE,
-    reuse across prompts (the reference re-runs O(t^2) work per token,
+    """KV-cache decoder: compile the whole-generation program ONCE, reuse
+    across prompts (the reference re-runs O(t^2) work per token,
     `test.py:145-152`; the no-cache jitted path in evaluate.py is
-    O(buf_len^2) per token AND pays one dispatch per token)."""
+    O(buf_len^2) per token AND pays one dispatch per token).
 
-    def __init__(self, model: Transformer, mesh: Mesh, buf_len: int):
+    Greedy by default (the name survives from that contract); pass
+    `temperature` / `top_k` for sampled decoding and a `seed` to
+    decode_batch for reproducible draws."""
+
+    def __init__(self, model: Transformer, mesh: Mesh, buf_len: int,
+                 temperature: float = 0.0, top_k: int = 0):
         if model.cp_size != 1:
             raise ValueError("decode is TP-only; build the decoder with a "
                              "cp_size=1 model (same params load fine)")
@@ -305,21 +334,23 @@ class GreedyDecoder:
         self.model = model
         self.mesh = mesh
         self.buf_len = buf_len
-        self.generate = make_generate(model, mesh, buf_len)
+        self.generate = make_generate(model, mesh, buf_len,
+                                      temperature=temperature, top_k=top_k)
 
     def decode(self, params, prompt_ids, eos_id: int,
-               max_total_len: int) -> list:
-        """Greedy-decode one prompt (ids incl. BOS); returns generated ids
+               max_total_len: int, seed: int = 0) -> list:
+        """Decode one prompt (ids incl. BOS); returns generated ids
         (prompt excluded), stopping at EOS or `max_total_len` total tokens.
         One device dispatch for the whole generation."""
         return self.decode_batch(params, [prompt_ids], eos_id,
-                                 max_total_len)[0]
+                                 max_total_len, seed=seed)[0]
 
     def decode_batch(self, params, prompts, eos_id: int,
-                     max_total_len: int) -> list:
-        """Greedy-decode a LIST of prompts (mixed lengths fine) in a single
+                     max_total_len: int, seed: int = 0) -> list:
+        """Decode a LIST of prompts (mixed lengths fine) in a single
         device dispatch; returns one generated-ids list per prompt. The
-        reference dispatches per prompt AND per token (`test.py:141-161`)."""
+        reference dispatches per prompt AND per token (`test.py:141-161`).
+        `seed` matters only for sampled decoders (temperature > 0)."""
         import numpy as np
 
         b = len(prompts)
@@ -334,7 +365,8 @@ class GreedyDecoder:
         buf, flen = self.generate(params, jnp.asarray(buf),
                                   jnp.asarray(plens),
                                   jnp.asarray(eos_id, jnp.int32),
-                                  jnp.asarray(max_total_len, jnp.int32))
+                                  jnp.asarray(max_total_len, jnp.int32),
+                                  jax.random.key(seed))
         buf, flen = np.asarray(buf), np.asarray(flen)
         return [buf[i, len(prompts[i]) : int(flen[i])].tolist()
                 for i in range(b)]

@@ -93,6 +93,9 @@ class GPT2Transformer:
         if cfg.kv_heads != cfg.num_heads:
             raise ValueError("grouped-query attention (num_kv_heads) is a "
                              "llama-family feature; the gpt2 family is MHA")
+        if cfg.num_experts:
+            raise ValueError("MoE (num_experts) is a llama-family feature; "
+                             "the gpt2 family is dense")
         validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
 
     # ---- static properties ----

@@ -327,7 +327,9 @@ def train(args: argparse.Namespace) -> dict:
     profiler = ProfilerTrace(os.path.join(args.save_dir, "logs"),
                              start_step=start_step + 3,
                              num_steps=args.profile_steps)
-    flops_step = model_flops_per_step(cfg, args.batch_size, maxlen)
+    flops_step = model_flops_per_step(
+        cfg, args.batch_size, maxlen,
+        params=params if args.family == "gpt2" else None)
     peak_flops = chip_peak_flops() * mesh_cfg.world_size
 
     # with accumulation one optimizer step consumes `accum` batches

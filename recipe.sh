@@ -26,11 +26,20 @@ TEXTS="$WORK/texts.json"
 TOKENIZER="$WORK/tokenizer/tokenizer.json"
 TOKENS="$WORK/tokens.json"
 
-# Step 1: download a FineWeb shard (reference recipe.sh:13-19)
-if [ ! -f "$PARQUET" ]; then
+# Step 1: download a FineWeb shard (reference recipe.sh:13-19). With no
+# network egress, fall back to the in-image docstring corpus
+# (scripts/make_image_corpus.py) — same filter/split/schema, so every later
+# step is identical.
+if [ -f "$TEXTS" ]; then
+    echo "== Step 1: $TEXTS exists, skipping download"
+elif [ ! -f "$PARQUET" ]; then
     echo "== Step 1: downloading FineWeb shard"
-    curl -fL "$FINEWEB_URL" -o "$PARQUET" || {
-        echo "download failed (no network?) — place a parquet at $PARQUET"; exit 1; }
+    if ! curl -fL --max-time 300 "$FINEWEB_URL" -o "$PARQUET"; then
+        echo "   download failed (no egress?) — harvesting the in-image corpus instead"
+        rm -f "$PARQUET"
+        python scripts/make_image_corpus.py "$TEXTS" \
+            --root "$(python -c 'import numpy, os; print(os.path.dirname(os.path.dirname(numpy.__file__)))')"
+    fi
 else
     echo "== Step 1: $PARQUET exists, skipping"
 fi
