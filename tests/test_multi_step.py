@@ -142,3 +142,34 @@ def test_cli_steps_per_dispatch_matches(tmp_path):
     # checkpoints at the crossing (4) and at 6
     assert [it for it, _ in list_checkpoints(str(tmp_path / "ck1"))] == [3, 6]
     assert [it for it, _ in list_checkpoints(str(tmp_path / "ck2"))] == [4, 6]
+
+
+def test_cli_spd_tail_shrinks_to_max_steps(tmp_path):
+    """max_steps not divisible by steps_per_dispatch: the final window must
+    shrink (round-3 prefetch loop slices it) so the run ends EXACTLY on
+    max_steps."""
+    import json
+
+    from distributed_pytorch_from_scratch_tpu import train as train_mod
+    from distributed_pytorch_from_scratch_tpu.data.tokenizer import (
+        pre_tokenize, train_bpe)
+
+    texts = ["the king rode out at dawn with his men",
+             "a quiet morning on the river bank",
+             "she sold sea shells by the sea shore",
+             "to be or not to be that is the question"] * 4
+    tj = tmp_path / "texts.json"
+    json.dump({"train": texts, "validation": texts[:2]}, open(tj, "w"))
+    train_bpe(str(tj), str(tmp_path / "tok.json"), vocab_size=270)
+    pre_tokenize(str(tj), str(tmp_path / "tokens.json"),
+                 str(tmp_path / "tok.json"))
+
+    r = train_mod.train(train_mod.get_train_args(
+        ["--data_path", str(tmp_path / "tokens.json"),
+         "--save_dir", str(tmp_path / "ck"),
+         "--attn_dim", "32", "--ffn_dim", "64", "--num_heads", "4",
+         "--num_layers", "2", "--maxlen", "32", "--batch_size", "4",
+         "--max_steps", "5", "--steps_per_dispatch", "3",
+         "--save_interval", "5", "--log_interval", "5",
+         "--warmup_steps", "2"]))
+    assert r["steps"] == 5, r
