@@ -29,10 +29,12 @@ Design notes:
   column-parallel (`gather_output=False`), wo + proj row-parallel
   (`split_input=False`) — one all-reduce per sublayer per direction.
 
-* Context parallelism (ring / Ulysses over 'cp') and Megatron sequence
-  parallelism over 'tp' compose with this family exactly like the llama
-  one — same collectives, no RoPE (positions are learned and enter at the
-  embedding, so the cp shards just index their position slice).
+* Context parallelism (ring / Ulysses over 'cp'), Megatron sequence
+  parallelism over 'tp' and the GPipe pipeline over 'pp' compose with this
+  family exactly like the llama one — same collectives and the same
+  (family-agnostic) microbatch schedule, no RoPE (positions are learned
+  and enter at the embedding, so the cp shards just index their position
+  slice).
 """
 
 from __future__ import annotations
@@ -54,7 +56,7 @@ from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
 from ..parallel.norm import LayerNorm
 from ..runtime.prng import fold
 from .transformer import (NEG_INF, Transformer, remat_wrap,
-                          validate_cp)
+                          validate_cp, validate_pp)
 
 Params = Dict[str, Any]
 
@@ -69,14 +71,17 @@ class GPT2Transformer:
     tp_size: int = 1
     attn_impl: str = "auto"
     remat: "bool | str" = True
-    # context parallelism over 'cp' and Megatron SP over 'tp', same
-    # semantics as the llama family; pp stays 1 (the pipeline's microbatch
-    # machinery lives in Transformer._pipeline_layers — llama only)
+    # context parallelism over 'cp', Megatron SP over 'tp', and the GPipe
+    # pipeline over 'pp' — all borrowed from the llama family's machinery
+    # (the microbatch schedule is Transformer._pipeline_layers, family-
+    # agnostic via stage_fn)
     cp_size: int = 1
     cp_impl: str = "ring"
     cp_layout: str = "contiguous"
     sequence_parallel: bool = False
     pp_size: int = 1
+    pp_microbatches: int = 0
+    pp_remat_steps: bool = False
 
     def __post_init__(self):
         cfg, tp = self.cfg, self.tp_size
@@ -97,6 +102,7 @@ class GPT2Transformer:
             raise ValueError("MoE (num_experts) is a llama-family feature; "
                              "the gpt2 family is dense")
         validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
+        validate_pp(cfg.num_layers, self.pp_size, self.pp_microbatches)
 
     # ---- static properties ----
 
@@ -171,8 +177,11 @@ class GPT2Transformer:
     def specs(self) -> Params:
         from jax.sharding import PartitionSpec as P
 
+        lead = "pp" if self.pp_size > 1 else None
+
         def stack(spec_dict: Params) -> Params:
-            return jax.tree.map(lambda s: P(None, *s), spec_dict,
+            # stacked num_layers axis: sharded over 'pp' when pipelining
+            return jax.tree.map(lambda s: P(lead, *s), spec_dict,
                                 is_leaf=lambda x: isinstance(x, P))
 
         return {
@@ -229,9 +238,11 @@ class GPT2Transformer:
         return x
 
     def forward_shard(self, params: Params, input_ids: jax.Array,
-                      position_ids: jax.Array) -> jax.Array:
+                      position_ids: jax.Array,
+                      head_layout: str = "replicated") -> jax.Array:
         """(b_local, t) ids -> (b_local, t, vocab_padded / tp) LOCAL logits —
-        the same per-shard contract as `Transformer.forward_shard`."""
+        the same per-shard contract as `Transformer.forward_shard`
+        (`head_layout` follows the same pipeline semantics)."""
         dtype = resolve_dtype(self.cfg.compute_dtype)
         sp = self.sequence_parallel
         if sp and input_ids.shape[1] % self.tp_size != 0:
@@ -253,10 +264,21 @@ class GPT2Transformer:
 
         layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(3,))
 
-        def body(carry, lp):
-            return layer_fn(carry, lp, position_ids, dtype), None
+        if self.pp_size > 1:
+            def stage_fn(z, layers, pos_m):
+                def body(carry, lp):
+                    return layer_fn(carry, lp, pos_m, dtype), None
+                z, _ = lax.scan(body, z, layers)
+                return z, None
 
-        x, _ = lax.scan(body, x, params["layers"])
+            x, _ = self._pipeline_layers(stage_fn, x, params["layers"],
+                                         (position_ids,),
+                                         head_layout=head_layout)
+        else:
+            def body(carry, lp):
+                return layer_fn(carry, lp, position_ids, dtype), None
+
+            x, _ = lax.scan(body, x, params["layers"])
         x = self.final_norm.apply(params["norm"], x)
         if sp:
             # the tied head consumes full-sequence activations; the gather's
@@ -283,8 +305,10 @@ class GPT2Transformer:
     def _forward_with_aux(self, params: Params, input_ids: jax.Array,
                           position_ids: jax.Array,
                           head_layout: str = "replicated"):
-        # head_layout is a pipeline concern; this family is pp_size == 1
-        return self.forward_shard(params, input_ids, position_ids), None
+        return self.forward_shard(params, input_ids, position_ids,
+                                  head_layout=head_layout), None
+
+    _pipeline_layers = Transformer._pipeline_layers
 
     _zigzag = Transformer._zigzag
     _token_ce = Transformer._token_ce

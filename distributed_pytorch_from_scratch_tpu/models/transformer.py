@@ -56,6 +56,23 @@ Params = Dict[str, Any]
 NEG_INF = -1e9  # mask value for padded vocab logits
 
 
+def validate_pp(num_layers: int, pp_size: int, pp_microbatches: int) -> None:
+    """Pipeline construction checks shared by both model families."""
+    if pp_size > 1 and num_layers % pp_size != 0:
+        raise ValueError(
+            f"num_layers {num_layers} not divisible by pp_size "
+            f"{pp_size} (stages hold equal layer counts)")
+    if pp_microbatches and pp_size == 1:
+        raise ValueError(
+            "pp_microbatches requires pp_size > 1 (a non-pipelined model "
+            "runs no microbatch schedule; the setting would be silently "
+            "ignored)")
+    if pp_microbatches and pp_microbatches < pp_size:
+        raise ValueError(
+            f"pp_microbatches {pp_microbatches} < pp_size "
+            f"{pp_size} would leave permanent pipeline bubbles")
+
+
 def validate_cp(cfg: ModelConfig, tp: int, cp_size: int, cp_impl: str,
                 cp_layout: str) -> None:
     """Context-parallel construction checks shared by both model families
@@ -189,20 +206,7 @@ class Transformer:
             raise ValueError("ep_size > 1 requires cfg.num_experts > 0 "
                              "(a dense model has nothing to shard over 'ep'; "
                              "use dp for a pure data axis)")
-        if self.pp_size > 1:
-            if cfg.num_layers % self.pp_size != 0:
-                raise ValueError(
-                    f"num_layers {cfg.num_layers} not divisible by pp_size "
-                    f"{self.pp_size} (stages hold equal layer counts)")
-        if self.pp_microbatches and self.pp_size == 1:
-            raise ValueError(
-                "pp_microbatches requires pp_size > 1 (a non-pipelined model "
-                "runs no microbatch schedule; the setting would be silently "
-                "ignored)")
-        if self.pp_microbatches and self.pp_microbatches < self.pp_size:
-            raise ValueError(
-                f"pp_microbatches {self.pp_microbatches} < pp_size "
-                f"{self.pp_size} would leave permanent pipeline bubbles")
+        validate_pp(cfg.num_layers, self.pp_size, self.pp_microbatches)
 
     # ---- sub-module definitions (static, cheap to rebuild) ----
 
@@ -437,8 +441,16 @@ class Transformer:
         layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(5,))
 
         if self.pp_size > 1:
-            x, aux = self._pipeline_layers(layer_fn, x, params["layers"], cos,
-                                           sin, position_ids, dtype,
+            def stage_fn(z, layers, cos_m, sin_m, pos_m):
+                def body(carry, lp):
+                    return layer_fn(carry, lp, cos_m, sin_m, pos_m, dtype)
+                z, auxs = lax.scan(body, z, layers)
+                aux = (jax.tree.map(lambda a: jnp.sum(a, axis=0), auxs)
+                       if self.is_moe else None)
+                return z, aux
+
+            x, aux = self._pipeline_layers(stage_fn, x, params["layers"],
+                                           (cos, sin, position_ids),
                                            head_layout=head_layout)
         else:
             def body(carry, layer_params):
@@ -463,10 +475,14 @@ class Transformer:
                                logits, jnp.asarray(NEG_INF, logits.dtype))
         return logits, aux
 
-    def _pipeline_layers(self, layer_fn, x: jax.Array, layers: Params,
-                         cos: jax.Array, sin: jax.Array, pos: jax.Array,
-                         dtype, head_layout: str = "replicated"):
-        """GPipe microbatch pipeline over the 'pp' mesh axis.
+    def _pipeline_layers(self, stage_fn, x: jax.Array, layers: Params,
+                         mb_arrays: Tuple[jax.Array, ...],
+                         head_layout: str = "replicated"):
+        """GPipe microbatch pipeline over the 'pp' mesh axis — family-
+        agnostic: `stage_fn(z, layers, *mb) -> (z', aux_or_None)` runs this
+        stage's layer stack on one microbatch, and `mb_arrays` are the
+        per-microbatch auxiliary inputs (leading dim = local batch b) each
+        family needs (llama: cos/sin/position_ids; gpt2: position_ids).
 
         `layers` arrive ALREADY sliced by shard_map to this stage's
         (num_layers/pp, ...) block (specs() shards the stacked layer dim
@@ -507,12 +523,10 @@ class Transformer:
         stage = lax.axis_index("pp")
         last = pp - 1
 
-        # (M, mb, ...) microbatch views; cos/sin/pos are replicated over pp
-        # so every stage can index its current microbatch locally.
+        # (M, mb, ...) microbatch views; the mb_arrays are replicated over
+        # pp so every stage can index its current microbatch locally.
         xs = x.reshape(M, mb, t, d)
-        cos_m = cos.reshape(M, mb, *cos.shape[1:])
-        sin_m = sin.reshape(M, mb, *sin.shape[1:])
-        pos_m = pos.reshape(M, mb, *pos.shape[1:])
+        mb_views = [a.reshape(M, mb, *a.shape[1:]) for a in mb_arrays]
 
         vary_axes = ("pp", "dp", "ep", "cp") + (
             ("tp",) if self.sequence_parallel else ())
@@ -523,12 +537,10 @@ class Transformer:
             # and cond branches must agree exactly
             return copy_to(z, vary_axes)
 
-        def local_layers(z, c, s_, p_):
-            def body(carry, lp):
-                return layer_fn(carry, lp, c, s_, p_, dtype)
-            z, auxs = lax.scan(body, z, layers)
-            aux = (jax.tree.map(lambda a: pvary(jnp.sum(a, axis=0)), auxs)
-                   if self.is_moe else None)
+        def local_layers(z, *mb_in):
+            z, aux = stage_fn(z, layers, *mb_in)
+            if self.is_moe:
+                aux = jax.tree.map(pvary, aux)
             return z, aux
 
         aux0 = (jax.tree.map(pvary, aux_zeros(self.cfg.num_experts))
@@ -547,7 +559,7 @@ class Transformer:
                                                       keepdims=False)
 
             def run(z):
-                return local_layers(z, take(cos_m), take(sin_m), take(pos_m))
+                return local_layers(z, *[take(v) for v in mb_views])
 
             def skip(z):
                 return z, aux0

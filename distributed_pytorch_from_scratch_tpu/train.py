@@ -75,7 +75,7 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--pp_size", type=int, default=1,
                    help="pipeline-parallel axis size: layers shard into "
                         "pp stages, microbatches flow through a GPipe "
-                        "schedule (llama family)")
+                        "schedule (both model families)")
     g.add_argument("--pp_microbatches", type=int, default=0,
                    help="microbatches per pipeline step (default pp_size; "
                         "more microbatches = smaller bubble fraction "
@@ -226,12 +226,9 @@ def train(args: argparse.Namespace) -> dict:
                          f"by dp_size*ep_size "
                          f"{args.dp_size * args.ep_size} (the batch shards "
                          f"over both axes)")
-    if args.family == "gpt2" and (args.ep_size > 1 or args.num_experts
-                                  or args.pp_size > 1):
-        raise SystemExit("--family gpt2 supports dp x cp x tp (+ "
-                         "--sequence_parallel); MoE and the pipeline are "
-                         "llama-family features "
-                         "(no --num_experts/--ep_size/--pp_size)")
+    if args.family == "gpt2" and (args.ep_size > 1 or args.num_experts):
+        raise SystemExit("--family gpt2 is dense: MoE is a llama-family "
+                         "feature (no --num_experts/--ep_size)")
     mesh = make_mesh(mesh_cfg)
 
     dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -257,6 +254,9 @@ def train(args: argparse.Namespace) -> dict:
                                 cp_size=args.cp_size, cp_impl=args.cp_impl,
                                 cp_layout=args.cp_layout,
                                 sequence_parallel=args.sequence_parallel,
+                                pp_size=args.pp_size,
+                                pp_microbatches=args.pp_microbatches,
+                                pp_remat_steps=args.pp_remat_steps,
                                 remat=REMAT_CHOICES[args.remat])
     else:
         model = Transformer(cfg, tp_size=args.tp_size,

@@ -172,13 +172,12 @@ def test_cli_family_gpt2_train_eval(tmp_path):
     assert len(result["decoded"]) == len(eval_mod.DECODE_PROMPTS)
 
 
-def test_cli_family_gpt2_rejects_moe_and_pp():
-    """cp/SP are gpt2-supported since round 3; MoE and the pipeline stay
-    llama-family features and must be rejected up front."""
+def test_cli_family_gpt2_rejects_moe():
+    """cp/SP/pp are gpt2-supported since round 3; MoE stays a llama-family
+    feature and must be rejected up front."""
     from distributed_pytorch_from_scratch_tpu import train as train_mod
 
-    for flags in (["--num_experts", "4"], ["--pp_size", "2"],
-                  ["--ep_size", "2"]):
+    for flags in (["--num_experts", "4"], ["--ep_size", "2"]):
         with pytest.raises(SystemExit, match="llama-family"):
             train_mod.train(train_mod.get_train_args(
                 ["--family", "gpt2", "--data_path", "x.json",
@@ -248,3 +247,36 @@ def test_gpt2_context_sequence_parallel_matches_vanilla(name, axes, kw):
     for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name,axes,kw", [
+    ("pp2", dict(pp=2), dict(pp_size=2)),
+    ("pp2tp2_m4", dict(pp=2, tp=2),
+     dict(pp_size=2, tp_size=2, pp_microbatches=4)),
+    ("pp2tp2_sp_remat", dict(pp=2, tp=2),
+     dict(pp_size=2, tp_size=2, sequence_parallel=True,
+          pp_remat_steps=True)),
+])
+def test_gpt2_pipeline_matches_vanilla(name, axes, kw):
+    """gpt2 through the (family-agnostic) GPipe schedule: loss + every
+    gradient leaf — including the tied embedding's double contribution
+    routed through stage-0 inject AND the pp-scattered head — match the
+    unsharded oracle."""
+    mesh = make_mesh(MeshConfig(**axes))
+    model = GPT2Transformer(CFG, **kw)
+    oracle = VanillaGPT2(CFG)
+    params = model.init(jax.random.key(0))
+    ids, tgt, pos = make_batch(jax.random.key(6), batch=8)
+
+    sp = jax.device_put(params, model.shardings(mesh))
+    l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(sp, ids, tgt, pos)
+    l_ref, g_ref = jax.value_and_grad(oracle.loss)(params, ids, tgt, pos)
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+    logits_sh = model.make_forward(mesh)(sp, ids, pos)
+    logits_ref = oracle.forward(params, ids, pos)
+    np.testing.assert_allclose(np.asarray(logits_sh),
+                               np.asarray(logits_ref), rtol=1e-4, atol=1e-4)
