@@ -114,14 +114,26 @@ def check_moe(dp, ep, tp):
     print(f"moe OK: dp{dp} x ep{ep} x tp{tp} loss={float(l_sh):.5f}")
 
 
-def check_pipeline(dp, pp, tp, m):
-    model_kw = dict(tp_size=tp, pp_size=pp, pp_microbatches=m)
+def check_pipeline(dp, pp, tp, m, num_layers=2, family="llama"):
+    import dataclasses
+
+    from distributed_pytorch_from_scratch_tpu.models.gpt2 import (
+        GPT2Transformer)
+    from distributed_pytorch_from_scratch_tpu.models.vanilla import (
+        VanillaGPT2)
+
+    cfg = dataclasses.replace(CFG, num_layers=num_layers)
+    cls = GPT2Transformer if family == "gpt2" else Transformer
     ids, tgt, pos = batch(jax.random.key(6))
-    ref = Transformer(CFG)
-    params = ref.init(jax.random.key(0))
-    l_ref, g_ref = jax.value_and_grad(ref.make_loss(make_mesh(MeshConfig())))(
-        params, ids, tgt, pos)
-    model = Transformer(CFG, **model_kw)
+    params = cls(cfg).init(jax.random.key(0))
+    if family == "gpt2":
+        oracle = VanillaGPT2(cfg)
+        l_ref, g_ref = jax.value_and_grad(oracle.loss)(params, ids, tgt, pos)
+    else:
+        ref = Transformer(cfg)
+        l_ref, g_ref = jax.value_and_grad(
+            ref.make_loss(make_mesh(MeshConfig())))(params, ids, tgt, pos)
+    model = cls(cfg, tp_size=tp, pp_size=pp, pp_microbatches=m)
     mesh = make_mesh(MeshConfig(dp=dp, pp=pp, tp=tp))
     sp = jax.device_put(params, model.shardings(mesh))
     l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(sp, ids, tgt, pos)
@@ -129,8 +141,8 @@ def check_pipeline(dp, pp, tp, m):
     for a, b in zip(jax.tree.flatten(g_sh)[0], jax.tree.flatten(g_ref)[0]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
-    print(f"pipeline OK: dp{dp} x pp{pp} x tp{tp} m={m} "
-          f"loss={float(l_sh):.5f}")
+    print(f"pipeline OK: {family} dp{dp} x pp{pp} x tp{tp} m={m} "
+          f"L={num_layers} loss={float(l_sh):.5f}")
 
 
 def main():
@@ -143,6 +155,8 @@ def main():
     check_zero1(8, 2)
     check_moe(2, 4, 2)       # 8 experts over ep=4, tp inside experts
     check_pipeline(2, 2, 4, 4)
+    check_pipeline(1, 4, 4, 8, num_layers=4)       # deep pipe: 4 stages
+    check_pipeline(2, 2, 4, 4, family="gpt2")      # second family, 16 dev
     print("wide-mesh sweep: ALL OK")
 
 
