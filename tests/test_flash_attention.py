@@ -212,3 +212,27 @@ def test_block_attention_matches_xla_block():
                    argnums=(0, 1, 2))(q, k, v)
     for name, a, b_ in zip("qkv", g_ref, g_k):
         np.testing.assert_allclose(b_, a, atol=5e-5, err_msg=f"d{name}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("group", [1, 2, 4])
+@pytest.mark.parametrize("t", [96, 256])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_gqa_kernel_shape_sweep(group, t, dtype):
+    """Broader (group, t, dtype) sweep of the GQA-routed kernels ahead of
+    hardware: forward vs the repeat+dense oracle at both the fused
+    (t<=128) and split block paths."""
+    from distributed_pytorch_from_scratch_tpu.ops.attention import (
+        causal_attention_xla)
+
+    key = jax.random.key(group * 1000 + t)
+    b, hkv, d = 2, 2, 32
+    hq = hkv * group
+    q = jax.random.normal(jax.random.fold_in(key, 1), (b, hq, t, d), dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 2), (b, hkv, t, d), dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 3), (b, hkv, t, d), dtype)
+    ref = causal_attention_xla(q, k, v)
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    atol = 3e-2 if dtype == jnp.bfloat16 else 2e-5
+    np.testing.assert_allclose(out.astype(jnp.float32),
+                               ref.astype(jnp.float32), atol=atol)
