@@ -16,7 +16,8 @@ echo "=== round-3 kernel checks on hardware ===" | tee -a "$R/session.log"
 timeout 900 python "$R/tpu_checks.py" 2>&1 | tee -a "$R/session.log"
 
 # ---- bench lines (task: BENCH_r03 evidence; driver re-runs bench.py itself)
-for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true"; do
+for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
+            "45m:--steps_per_dispatch 16"; do
   model="${spec%%:*}"; extra="${spec#*:}"
   tag="${model}$(echo "$extra" | tr -d ' -')"
   if [ ! -s "$R/bench_${tag}.json" ]; then
