@@ -304,9 +304,6 @@ def evaluate(args: argparse.Namespace) -> dict:
     # decoding runs the cp=1 path on the same params (models/decode.py),
     # with its batch replicated over dp/cp.
     if args.family == "gpt2":
-        if cfg.num_experts:
-            raise SystemExit("--family gpt2 is dense (MoE is a llama-family "
-                             "feature; no --num_experts)")
         from .models.gpt2 import GPT2Transformer
         model_val = GPT2Transformer(cfg, tp_size=args.tp_size,
                                     cp_size=args.cp_size,

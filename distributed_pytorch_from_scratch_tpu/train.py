@@ -122,7 +122,8 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="model family: 'llama' = the reference architecture "
                         "(RoPE/RMSNorm/SwiGLU), 'gpt2' = LayerNorm/GELU/"
                         "learned positions/tied embeddings (models/gpt2.py; "
-                        "dp x tp only)")
+                        "composes with dp/tp/cp/SP/pp/ep like llama — GQA "
+                        "is the one llama-only feature)")
     g.add_argument("--model", choices=sorted(MODEL_PRESETS), default=None,
                    help="named shape preset (BASELINE configs: '45m' is the "
                         "reference shape, 'gpt2-124m' is config 3); explicit "
@@ -138,8 +139,8 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--maxlen", type=int, default=None)
     g.add_argument("--num_experts", type=int, default=None,
                    help="Mixture-of-Experts: swap every layer's FFN for N "
-                        "routed experts (llama family; default 0 = dense "
-                        "SwiGLU like the reference)")
+                        "routed experts (both families; default 0 = dense "
+                        "FFN like the reference)")
     g.add_argument("--moe_top_k", type=int, default=None,
                    help="experts activated per token (default 2)")
     g.add_argument("--moe_capacity_factor", type=float, default=None,
@@ -226,9 +227,6 @@ def train(args: argparse.Namespace) -> dict:
                          f"by dp_size*ep_size "
                          f"{args.dp_size * args.ep_size} (the batch shards "
                          f"over both axes)")
-    if args.family == "gpt2" and (args.ep_size > 1 or args.num_experts):
-        raise SystemExit("--family gpt2 is dense: MoE is a llama-family "
-                         "feature (no --num_experts/--ep_size)")
     mesh = make_mesh(mesh_cfg)
 
     dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -254,7 +252,7 @@ def train(args: argparse.Namespace) -> dict:
                                 cp_size=args.cp_size, cp_impl=args.cp_impl,
                                 cp_layout=args.cp_layout,
                                 sequence_parallel=args.sequence_parallel,
-                                pp_size=args.pp_size,
+                                ep_size=args.ep_size, pp_size=args.pp_size,
                                 pp_microbatches=args.pp_microbatches,
                                 pp_remat_steps=args.pp_remat_steps,
                                 remat=REMAT_CHOICES[args.remat])

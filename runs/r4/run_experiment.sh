@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-4 hardware session: kernel checks + bench lines + the reference's
+# real experiment (VERDICT r3 #1 — two rounds overdue). Based on
+# runs/r3/run_experiment.sh; adds the t=8k long-context cp bench line
+# (VERDICT r3 #8). Idempotent; everything lands under runs/r4/.
+set -u
+cd /root/repo
+R=runs/r4
+mkdir -p "$R"
+
+echo "=== TPU check $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+timeout 120 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu', d; print('devices:', d)" \
+    2>&1 | tee -a "$R/session.log" || exit 17
+
+echo "=== kernel checks on hardware ===" | tee -a "$R/session.log"
+if [ ! -s "$R/tpu_checks.ok" ]; then
+  timeout 900 python runs/r3/tpu_checks.py 2>&1 | tee -a "$R/session.log" \
+    && echo ok > "$R/tpu_checks.ok"
+fi
+
+# ---- bench lines (BENCH_r04 evidence; driver re-runs bench.py itself)
+for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
+            "45m:--steps_per_dispatch 16" "45m:--maxlen 8192 --batch_size 2"; do
+  model="${spec%%:*}"; extra="${spec#*:}"
+  tag="${model}$(echo "$extra" | tr -d ' -')"
+  if [ ! -s "$R/bench_${tag}.json" ]; then
+    echo "=== bench $model $extra ===" | tee -a "$R/session.log"
+    # shellcheck disable=SC2086
+    timeout 1200 python bench.py --model "$model" $extra \
+        > "$R/bench_${tag}.json" 2>> "$R/session.log" \
+      && cat "$R/bench_${tag}.json" | tee -a "$R/session.log"
+  fi
+done
+
+# ---- the real training run (recipe steps 5+8 analogue on hardware)
+TOKENS=/tmp/corpus_tokens.json
+if [ ! -s "$TOKENS" ]; then
+  echo "regenerating corpus (tmp was cleared)" | tee -a "$R/session.log"
+  python scripts/make_image_corpus.py /tmp/corpus_texts.json \
+      --root /opt/venv/lib/python3.12/site-packages 2>>"$R/session.log"
+  python -m distributed_pytorch_from_scratch_tpu.data.tokenizer encode \
+      -i /tmp/corpus_texts.json -o "$TOKENS" -t "$R/tokenizer.json" \
+      2>>"$R/session.log"
+fi
+
+if [ ! -s "$R/train.log" ] || ! grep -q "training finished" "$R/train.log"; then
+  echo "=== 45M training run ===" | tee -a "$R/session.log"
+  timeout 14400 python -m distributed_pytorch_from_scratch_tpu.train \
+    --data_path "$TOKENS" --save_dir "$R/ckpt" \
+    --bf16 --batch_size 32 --maxlen 512 \
+    --max_steps 5000 --warmup_steps 500 --lr 3e-4 \
+    --steps_per_dispatch 8 --remat dots \
+    --log_interval 100 --save_interval 500 --reserve_last_n_ckpts 12 \
+    --resume 2>&1 | tee "$R/train.log" | tail -60
+fi
+
+echo "=== evaluate: val sweep + decodes ===" | tee -a "$R/session.log"
+timeout 3600 python -m distributed_pytorch_from_scratch_tpu.evaluate \
+  --data_path "$TOKENS" --ckpt_dir "$R/ckpt" \
+  --tokenizer_path "$R/tokenizer.json" \
+  --maxlen 512 --batch_size 8 --max_decode_len 64 \
+  2>&1 | tee "$R/eval.log" | tail -40
+echo "=== done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every ~3 minutes; launch the round-4 hardware
+# session the moment a real (non-cpu) backend answers. Probe log:
+# /tmp/tpu_status_r4.txt. Safe to restart; exits after one successful run.
+set -u
+LOG=/tmp/tpu_status_r4.txt
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" \
+      >/dev/null 2>&1; then
+    echo "$ts UP — launching run_experiment.sh" >> "$LOG"
+    bash /root/repo/runs/r4/run_experiment.sh >> /root/repo/runs/r4/launcher.log 2>&1
+    echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
+    exit 0
+  fi
+  echo "$ts down" >> "$LOG"
+  sleep 180
+done

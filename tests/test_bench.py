@@ -14,6 +14,33 @@ pytestmark = pytest.mark.slow
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_backend_outage_emits_machine_readable_json():
+    """VERDICT r3 #4: an unreachable backend (the ONLY bench failure mode
+    seen in three rounds — BENCH_r02/r03 rc=1) must yield one parseable
+    `{"error": "backend_unavailable"}` line and a distinct rc, for both
+    outage shapes: plugin init raising, and plugin init hanging forever."""
+    script = (
+        "import bench, time\n"
+        "import sys\n"
+        "mode = sys.argv[1]\n"
+        "def raising():\n"
+        "    raise RuntimeError('Unable to initialize backend: tunnel down')\n"
+        "def hanging():\n"
+        "    time.sleep(120)\n"
+        "bench._discover_backend(probe=raising if mode == 'raise' else hanging,"
+        " timeout_s=0.5)\n")
+    for mode in ("raise", "hang"):
+        p = subprocess.run([sys.executable, "-c", script, mode],
+                           capture_output=True, text=True, timeout=120,
+                           cwd=REPO_ROOT)
+        assert p.returncode == 3, (mode, p.returncode, p.stderr[-1000:])
+        lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+        assert len(lines) == 1, (mode, p.stdout)
+        rec = json.loads(lines[0])
+        assert rec["error"] == "backend_unavailable", rec
+        assert "detail" in rec, rec
+
+
 @pytest.mark.parametrize("extra", [
     ["--steps_per_dispatch", "1", "--tp", "1"],
     ["--steps_per_dispatch", "2", "--tp", "2"],

@@ -172,16 +172,87 @@ def test_cli_family_gpt2_train_eval(tmp_path):
     assert len(result["decoded"]) == len(eval_mod.DECODE_PROMPTS)
 
 
-def test_cli_family_gpt2_rejects_moe():
-    """cp/SP/pp are gpt2-supported since round 3; MoE stays a llama-family
-    feature and must be rejected up front."""
-    from distributed_pytorch_from_scratch_tpu import train as train_mod
+MOE_CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=2,
+                      vocab_size=96, maxlen=64, num_experts=4, moe_top_k=2,
+                      moe_capacity_factor=8.0)  # ample: zero drops -> exact
 
-    for flags in (["--num_experts", "4"], ["--ep_size", "2"]):
-        with pytest.raises(SystemExit, match="llama-family"):
-            train_mod.train(train_mod.get_train_args(
-                ["--family", "gpt2", "--data_path", "x.json",
-                 "--max_steps", "1"] + flags))
+
+@pytest.mark.parametrize("name,axes,kw", [
+    ("ep2", dict(ep=2), dict(ep_size=2)),
+    ("ep2tp2", dict(ep=2, tp=2), dict(ep_size=2, tp_size=2)),
+    ("dp2ep2tp2", dict(dp=2, ep=2, tp=2), dict(ep_size=2, tp_size=2)),
+    ("ep2tp2_sp", dict(ep=2, tp=2),
+     dict(ep_size=2, tp_size=2, sequence_parallel=True)),
+    ("pp2ep2", dict(pp=2, ep=2), dict(pp_size=2, ep_size=2)),
+])
+def test_gpt2_moe_matches_single_device(name, axes, kw):
+    """gpt2 + MoE (VERDICT r3 #5 — the family matrix's last hole): loss,
+    logits and every gradient leaf match the SAME model on a 1-device mesh,
+    including the router aux losses riding the (family-agnostic) pipeline
+    carry on the pp2 x ep2 shape."""
+    key = jax.random.key(0)
+    ids, tgt, pos = make_batch(jax.random.key(2), batch=8)
+
+    ref_model = GPT2Transformer(MOE_CFG)
+    ref_mesh = make_mesh(MeshConfig())
+    params = ref_model.init(key)
+    l_ref, g_ref = jax.value_and_grad(ref_model.make_loss(ref_mesh))(
+        params, ids, tgt, pos)
+    logits_ref = ref_model.make_forward(ref_mesh)(params, ids, pos)
+
+    model = GPT2Transformer(MOE_CFG, **kw)
+    mesh = make_mesh(MeshConfig(**axes))
+    sh_params = jax.device_put(params, model.shardings(mesh))
+    l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(
+        sh_params, ids, tgt, pos)
+    logits_sh = model.make_forward(mesh)(sh_params, ids, pos)
+
+    np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(logits_sh), np.asarray(logits_ref),
+                               rtol=1e-4, atol=1e-4)
+    for a, b in zip(jax.tree.leaves(g_sh), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_gpt2_moe_param_tree():
+    model = GPT2Transformer(MOE_CFG, tp_size=2, ep_size=2)
+    params = model.init(jax.random.key(0))
+    assert set(params["layers"]) == {"ln1", "wq", "wk", "wv", "wo",
+                                     "ln2", "moe"}
+    jax.tree.map(lambda *_: None, params, model.specs())
+
+
+def test_gpt2_moe_kv_decode_matches_forward_argmax():
+    """The generic KV decoder through a gpt2 MoE: greedy decode == argmax
+    over the full forward (same check as the dense-family decode test)."""
+    from distributed_pytorch_from_scratch_tpu.models.decode import (
+        GreedyDecoder)
+
+    mesh = make_mesh(MeshConfig(ep=2, tp=2))
+    model = GPT2Transformer(MOE_CFG, ep_size=2, tp_size=2)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    fwd = model.make_forward(mesh)
+
+    prompt = [1, 5, 9, 13]
+    buf_len = 12
+    dec = GreedyDecoder(model, mesh, buf_len)
+    gen = dec.decode_batch(params, [prompt], eos_id=-1,
+                           max_total_len=buf_len)[0]
+
+    ids = list(prompt)
+    while len(ids) < buf_len:
+        buf = jnp.asarray([(ids + [0] * (buf_len - len(ids)))] * 2)
+        pos = jnp.tile(jnp.arange(buf_len)[None, :], (2, 1))
+        logits = fwd(params, buf, pos)[0, len(ids) - 1, : MOE_CFG.vocab_size]
+        ids.append(int(jnp.argmax(logits)))
+    assert gen == ids[len(prompt):], (gen, ids[len(prompt):])
+
+
+def test_gpt2_moe_validation():
+    with pytest.raises(ValueError, match="requires cfg.num_experts"):
+        GPT2Transformer(CFG, ep_size=2)
 
 
 def test_gpt2_kv_decode_matches_forward_argmax():

@@ -235,3 +235,73 @@ def test_doc_loss_zigzag_matches_single_device():
     np.testing.assert_array_equal(np.asarray(real), np.asarray(real_ref))
     np.testing.assert_allclose(np.asarray(means), np.asarray(means_ref),
                                rtol=1e-5, atol=1e-6)
+
+
+# ---- ring + flash kernel composition (VERDICT r3 #2) ----
+#
+# In product code, `_block_attn` falls back to dense XLA whenever the
+# interpreted Pallas kernel would run inside a vma-checked shard_map (the
+# discharged kernel jaxpr fails the varying-manual-axes check), so the
+# composed ring+flash path — the Pallas positional block kernel driven by
+# the online-softmax combine with real ppermutes — never executed in any
+# CPU test. `check_vma=False` removes the tags entirely: the gate at
+# ops/ring_attention.py::_block_attn sees no vma, takes the kernel path,
+# and the FULL composition runs interpreted inside a cp>1 mesh. These
+# tests pin its forward and backward against the dense oracle.
+
+
+def flash_ring(mesh, layout_pos=None):
+    fn = functools.partial(ring_attention, axis="cp", impl="flash")
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(None, "tp", "cp", None),) * 3 + (P(None, "cp"),),
+        out_specs=P(None, "tp", "cp", None), check_vma=False))
+
+
+@pytest.mark.parametrize("cp,tp", [(2, 1), (2, 2)])
+def test_flash_blocks_execute_inside_cp_mesh(cp, tp):
+    """impl='flash' blocks run INSIDE a cp>1 shard_map (interpreted kernel,
+    real ppermutes, online-softmax combine) and match the dense oracle."""
+    mesh = make_mesh(MeshConfig(dp=1, cp=cp, tp=tp))
+    q, k, v, pos = make_qkv(jax.random.key(11), h=2 * tp, t=128, d=64)
+    out = flash_ring(mesh)(q, k, v, pos)
+    ref = causal_attention_xla(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_flash_ring_cp4_gqa_matches_dense():
+    """cp=4 ring with GROUPED k/v (hkv < hq): the BlockSpec head routing
+    composes with the ring's half-chunk skipping."""
+    mesh = make_mesh(MeshConfig(dp=1, cp=4, tp=1))
+    b, hq, hkv, t, d = 1, 4, 2, 256, 64
+    kq, kk, kv = jax.random.split(jax.random.key(12), 3)
+    q = jax.random.normal(kq, (b, hq, t, d), jnp.float32)
+    k = jax.random.normal(kk, (b, hkv, t, d), jnp.float32)
+    v = jax.random.normal(kv, (b, hkv, t, d), jnp.float32)
+    pos = jnp.tile(jnp.arange(t, dtype=jnp.int32)[None, :], (b, 1))
+    out = flash_ring(mesh)(q, k, v, pos)
+    ref = causal_attention_xla(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_flash_ring_grads_match_dense():
+    """Backward through the composition: the kernel's custom VJP consumes
+    the combine's (do, dlse) cotangents and the scan/ppermute transpose
+    rebuilds the reverse ring — gradients must match the dense kernel's."""
+    mesh = make_mesh(MeshConfig(dp=1, cp=2, tp=1))
+    q, k, v, pos = make_qkv(jax.random.key(13), h=2, t=128, d=64)
+    w = jax.random.normal(jax.random.key(14), q.shape, jnp.float32)
+
+    ring = flash_ring(mesh)
+    g_ring = jax.grad(lambda q, k, v: jnp.sum(ring(q, k, v, pos) * w),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(causal_attention_xla(q, k, v) * w),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
