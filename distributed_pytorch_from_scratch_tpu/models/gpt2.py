@@ -83,6 +83,8 @@ class GPT2Transformer:
     pp_size: int = 1
     pp_microbatches: int = 0
     pp_remat_steps: bool = False
+    pp_schedule: str = "gpipe"   # or 'interleaved' (virtual stages);
+    pp_virtual: int = 2          # see Transformer.pp_schedule
     # Expert parallelism (with cfg.num_experts > 0): the gelu MLP swaps for
     # the same routed-expert sublayer the llama family uses
     # (parallel/moe.py — SwiGLU experts; documented design choice, see
@@ -110,7 +112,8 @@ class GPT2Transformer:
                              "(a dense model has nothing to shard over 'ep'; "
                              "use dp for a pure data axis)")
         validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
-        validate_pp(cfg.num_layers, self.pp_size, self.pp_microbatches)
+        validate_pp(cfg.num_layers, self.pp_size, self.pp_microbatches,
+                    self.pp_schedule, self.pp_virtual)
 
     # ---- static properties ----
 
@@ -193,11 +196,14 @@ class GPT2Transformer:
             return {name: mod.init(fold(k, name))
                     for name, mod in self._mods.items()}
 
+        layers = jax.vmap(one_layer)(layer_keys)
+        if self._interleaved:
+            layers = self._layers_to_schedule(layers)
         return {
             "embedding": self.embedding.init(fold(key, "embedding")),
             "pos_embedding": {"weight": INIT_STD * jax.random.normal(
                 fold(key, "pos"), (self.cfg.maxlen, self.d), jnp.float32)},
-            "layers": jax.vmap(one_layer)(layer_keys),
+            "layers": layers,
             "norm": self.final_norm.init(fold(key, "norm")),
         }
 
@@ -208,6 +214,11 @@ class GPT2Transformer:
 
         def stack(spec_dict: Params) -> Params:
             # stacked num_layers axis: sharded over 'pp' when pipelining
+            # ((V, pp, Lv) dim-1 for the interleaved schedule)
+            if self._interleaved:
+                return jax.tree.map(lambda s: P(None, "pp", None, *s),
+                                    spec_dict,
+                                    is_leaf=lambda x: isinstance(x, P))
             return jax.tree.map(lambda s: P(lead, *s), spec_dict,
                                 is_leaf=lambda x: isinstance(x, P))
 
@@ -222,7 +233,11 @@ class GPT2Transformer:
     # ---- per-shard forward (inside shard_map) ----
 
     def _layer_body(self, x: jax.Array, lp: Params, pos: jax.Array,
-                    dtype) -> jax.Array:
+                    dtype, live=None) -> jax.Array:
+        """One GPT-2 block. `live` is the pp x ring-CP bubble gate — same
+        contract as `Transformer._layer_body` (the shared
+        `_live_gated_ring` wraps the dense segments in lax.cond while the
+        ring's ppermutes run unconditionally)."""
         m = self._mods
         h = self.cfg.head_dim
         # sequence parallelism: x is (b, t/tp, d) between sublayers; the
@@ -237,43 +252,56 @@ class GPT2Transformer:
         b = x.shape[0]
         t = pos.shape[1]  # full (cp-local) sequence length, not x.shape[1]
 
-        y = maybe_gather(m["ln1"].apply(lp["ln1"], x))
-        q = m["wq"].apply(lp["wq"], y, dtype, input_layout=in_layout)
-        k = m["wk"].apply(lp["wk"], y, dtype, input_layout=in_layout)
-        v = m["wv"].apply(lp["wv"], y, dtype, input_layout=in_layout)
-        split = lambda z: z.reshape(b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
-        q, k, v = split(q), split(k), split(v)
-        if self.cp_size > 1:
-            if self.cp_impl == "ring":
-                o = ring_attention(q, k, v, pos, axis="cp",
-                                   impl=self.attn_impl)
-            else:
-                o = ulysses_attention(q, k, v, axis="cp", impl=self.attn_impl)
-        else:
-            o = causal_attention(q, k, v, impl=self.attn_impl)
-        o = o.transpose(0, 2, 1, 3).reshape(b, t, self.num_local_heads * h)
-        x = x + m["wo"].apply(lp["wo"], o, dtype, output_layout=out_layout)
+        def qkv(x):
+            y = maybe_gather(m["ln1"].apply(lp["ln1"], x))
+            q = m["wq"].apply(lp["wq"], y, dtype, input_layout=in_layout)
+            k = m["wk"].apply(lp["wk"], y, dtype, input_layout=in_layout)
+            v = m["wv"].apply(lp["wv"], y, dtype, input_layout=in_layout)
+            split = lambda z: z.reshape(
+                b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
+            return split(q), split(k), split(v)
 
-        y = maybe_gather(m["ln2"].apply(lp["ln2"], x))
-        if self.is_moe:
-            ff, aux = m["moe"].apply(lp["moe"], y, dtype)
-            if sp:
-                # Same SP composition as the llama body: the router saw the
-                # tp-gathered tokens, ff is full-value on every rank — keep
-                # this rank's sequence slice so the residual stays
-                # seq-sharded.
-                tl = ff.shape[1] // self.tp_size
-                ff = lax.dynamic_slice_in_dim(
-                    ff, lax.axis_index("tp") * tl, tl, axis=1)
-            return x + ff, aux
-        # gelu_new (tanh approximation), like GPT-2
-        x = x + m["proj"].apply(lp["proj"],
-                                jax.nn.gelu(m["fc"].apply(
-                                    lp["fc"], y, dtype,
-                                    input_layout=in_layout),
-                                    approximate=True), dtype,
-                                output_layout=out_layout)
-        return x, None
+        def attn_out(args):
+            x, o = args
+            o = o.transpose(0, 2, 1, 3).reshape(b, t,
+                                                self.num_local_heads * h)
+            x = x + m["wo"].apply(lp["wo"], o, dtype,
+                                  output_layout=out_layout)
+
+            y = maybe_gather(m["ln2"].apply(lp["ln2"], x))
+            if self.is_moe:
+                ff, aux = m["moe"].apply(lp["moe"], y, dtype)
+                if sp:
+                    # Same SP composition as the llama body: the router saw
+                    # the tp-gathered tokens, ff is full-value on every
+                    # rank — keep this rank's sequence slice so the
+                    # residual stays seq-sharded.
+                    tl = ff.shape[1] // self.tp_size
+                    ff = lax.dynamic_slice_in_dim(
+                        ff, lax.axis_index("tp") * tl, tl, axis=1)
+                return x + ff, aux
+            # gelu_new (tanh approximation), like GPT-2
+            x = x + m["proj"].apply(lp["proj"],
+                                    jax.nn.gelu(m["fc"].apply(
+                                        lp["fc"], y, dtype,
+                                        input_layout=in_layout),
+                                        approximate=True), dtype,
+                                    output_layout=out_layout)
+            return x, None
+
+        if live is None:
+            q, k, v = qkv(x)
+            if self.cp_size > 1:
+                if self.cp_impl == "ring":
+                    o = ring_attention(q, k, v, pos, axis="cp",
+                                       impl=self.attn_impl)
+                else:
+                    o = ulysses_attention(q, k, v, axis="cp",
+                                          impl=self.attn_impl)
+            else:
+                o = causal_attention(q, k, v, impl=self.attn_impl)
+            return attn_out((x, o))
+        return self._live_gated_ring(x, qkv, attn_out, pos, live)
 
     def forward_shard(self, params: Params, input_ids: jax.Array,
                       position_ids: jax.Array,
@@ -313,9 +341,9 @@ class GPT2Transformer:
         layer_fn = remat_wrap(self._layer_body, self.remat, static_argnums=(3,))
 
         if self.pp_size > 1:
-            def stage_fn(z, layers, pos_m):
+            def stage_fn(z, layers, pos_m, live=None):
                 def body(carry, lp):
-                    return layer_fn(carry, lp, pos_m, dtype)
+                    return layer_fn(carry, lp, pos_m, dtype, live)
                 z, auxs = lax.scan(body, z, layers)
                 aux = (jax.tree.map(lambda a: jnp.sum(a, axis=0), auxs)
                        if self.is_moe else None)
@@ -355,6 +383,15 @@ class GPT2Transformer:
         return self.num_local_heads  # MHA: the decoder's caches are full-size
 
     _pipeline_layers = Transformer._pipeline_layers
+    _pipeline_interleaved = Transformer._pipeline_interleaved
+    _pp_vary_axes = Transformer._pp_vary_axes
+    _live_gated_ring = Transformer._live_gated_ring
+    _interleaved = Transformer._interleaved
+    _layers_to_schedule = Transformer._layers_to_schedule
+    _layers_to_canonical = Transformer._layers_to_canonical
+    to_canonical = Transformer.to_canonical
+    from_canonical = Transformer.from_canonical
+    canonical_specs = Transformer.canonical_specs
 
     _zigzag = Transformer._zigzag
     _token_ce = Transformer._token_ce

@@ -47,6 +47,14 @@ from .training.train_step import (build_grad_accum_step, build_train_step,
 from .training.zero import zero1_moment_shardings
 
 
+def _map_moments(opt_state, fn):
+    """Apply `fn` (a params-tree transform, e.g. model.to_canonical) to the
+    Adam moments — they shard/reshape exactly like their params. Identity
+    transforms return the state unchanged."""
+    return opt_state.__class__(step=opt_state.step, mu=fn(opt_state.mu),
+                               nu=fn(opt_state.nu))
+
+
 def get_train_args(argv=None) -> argparse.Namespace:
     p = argparse.ArgumentParser(description=__doc__)
 
@@ -84,6 +92,18 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="rematerialise each pipeline step: backward "
                         "residuals shrink to the (mb, t, d) step carries "
                         "(the 1F1B-style memory cut) for ~33%% recompute")
+    g.add_argument("--pp_schedule", choices=["gpipe", "interleaved"],
+                   default="gpipe",
+                   help="'interleaved' = Megatron-style virtual stages: "
+                        "each device owns pp_virtual round-robin layer "
+                        "blocks and microbatches circulate the ring "
+                        "pp_virtual times — bubble drops from "
+                        "(pp-1)/(m+pp-1) to (pp-1)/(pp_virtual*m+pp-1) at "
+                        "the cost of pp_virtual x more ppermute hops")
+    g.add_argument("--pp_virtual", type=int, default=2,
+                   help="virtual stages per device for "
+                        "--pp_schedule interleaved (num_layers must "
+                        "divide by pp_size*pp_virtual)")
 
     g = p.add_argument_group("training")
     g.add_argument("--lr", type=float, default=3e-4)
@@ -255,6 +275,8 @@ def train(args: argparse.Namespace) -> dict:
                                 ep_size=args.ep_size, pp_size=args.pp_size,
                                 pp_microbatches=args.pp_microbatches,
                                 pp_remat_steps=args.pp_remat_steps,
+                                pp_schedule=args.pp_schedule,
+                                pp_virtual=args.pp_virtual,
                                 remat=REMAT_CHOICES[args.remat])
     else:
         model = Transformer(cfg, tp_size=args.tp_size,
@@ -264,6 +286,8 @@ def train(args: argparse.Namespace) -> dict:
                         ep_size=args.ep_size, pp_size=args.pp_size,
                         pp_microbatches=args.pp_microbatches,
                         pp_remat_steps=args.pp_remat_steps,
+                        pp_schedule=args.pp_schedule,
+                        pp_virtual=args.pp_virtual,
                         remat=REMAT_CHOICES[args.remat])
     ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
                            max_steps=args.max_steps,
@@ -286,8 +310,13 @@ def train(args: argparse.Namespace) -> dict:
         last = latest_step(args.save_dir)
         if last is not None:
             params, opt_state, start_step = load_checkpoint(
-                args.save_dir, last, params, model.specs(), with_opt=True)
-            opt_state = opt_state if opt_state is not None else init_adam_state(params)
+                args.save_dir, last, model.to_canonical(params),
+                model.canonical_specs(), with_opt=True)
+            params = model.from_canonical(params)
+            if opt_state is None:
+                opt_state = init_adam_state(params)
+            else:
+                opt_state = _map_moments(opt_state, model.from_canonical)
             print(f"resumed from iter {start_step} in {args.save_dir}")
 
     shardings = model.shardings(mesh)
@@ -364,9 +393,10 @@ def train(args: argparse.Namespace) -> dict:
         nonlocal pending_save, last_saved
         avg = float(accum_loss) / (step - start_step)
         join_save()  # bound in-flight async writes to one
+        save_opt = _map_moments(opt_state, model.to_canonical)
         pending_save = save_checkpoint(
-            args.save_dir, step, avg, params, model.specs(),
-            args.tp_size, opt_state,
+            args.save_dir, step, avg, model.to_canonical(params),
+            model.canonical_specs(), args.tp_size, save_opt,
             reserve_last_n=args.reserve_last_n_ckpts,
             async_write=True)
         last_saved = step

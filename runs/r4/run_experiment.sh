@@ -4,6 +4,7 @@
 # runs/r3/run_experiment.sh; adds the t=8k long-context cp bench line
 # (VERDICT r3 #8). Idempotent; everything lands under runs/r4/.
 set -u
+set -o pipefail  # the tee pipelines below must report python's status, not tee's
 cd /root/repo
 R=runs/r4
 mkdir -p "$R"
@@ -14,8 +15,8 @@ timeout 120 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu',
 
 echo "=== kernel checks on hardware ===" | tee -a "$R/session.log"
 if [ ! -s "$R/tpu_checks.ok" ]; then
-  timeout 900 python runs/r3/tpu_checks.py 2>&1 | tee -a "$R/session.log" \
-    && echo ok > "$R/tpu_checks.ok"
+  if timeout 900 python runs/r3/tpu_checks.py 2>&1 | tee -a "$R/session.log"
+  then echo ok > "$R/tpu_checks.ok"; fi
 fi
 
 # ---- bench lines (BENCH_r04 evidence; driver re-runs bench.py itself)
@@ -23,12 +24,22 @@ for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
             "45m:--steps_per_dispatch 16" "45m:--maxlen 8192 --batch_size 2"; do
   model="${spec%%:*}"; extra="${spec#*:}"
   tag="${model}$(echo "$extra" | tr -d ' -')"
+  # a backend_unavailable error line (bench.py rc=3, e.g. tunnel dropped
+  # mid-session) must not satisfy the idempotence guard — delete it so the
+  # line re-runs when the tunnel recovers
+  if grep -q '"error"' "$R/bench_${tag}.json" 2>/dev/null; then
+    rm -f "$R/bench_${tag}.json"
+  fi
   if [ ! -s "$R/bench_${tag}.json" ]; then
     echo "=== bench $model $extra ===" | tee -a "$R/session.log"
     # shellcheck disable=SC2086
-    timeout 1200 python bench.py --model "$model" $extra \
-        > "$R/bench_${tag}.json" 2>> "$R/session.log" \
-      && cat "$R/bench_${tag}.json" | tee -a "$R/session.log"
+    if ! timeout 1200 python bench.py --model "$model" $extra \
+        > "$R/bench_${tag}.json" 2>> "$R/session.log"; then
+      echo "bench $tag failed rc=$?" | tee -a "$R/session.log"
+      rm -f "$R/bench_${tag}.json"
+    else
+      cat "$R/bench_${tag}.json" | tee -a "$R/session.log"
+    fi
   fi
 done
 

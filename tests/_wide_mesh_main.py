@@ -114,7 +114,8 @@ def check_moe(dp, ep, tp):
     print(f"moe OK: dp{dp} x ep{ep} x tp{tp} loss={float(l_sh):.5f}")
 
 
-def check_pipeline(dp, pp, tp, m, num_layers=2, family="llama"):
+def check_pipeline(dp, pp, tp, m, num_layers=2, family="llama",
+                   schedule="gpipe"):
     import dataclasses
 
     from distributed_pytorch_from_scratch_tpu.models.gpt2 import (
@@ -133,16 +134,18 @@ def check_pipeline(dp, pp, tp, m, num_layers=2, family="llama"):
         ref = Transformer(cfg)
         l_ref, g_ref = jax.value_and_grad(
             ref.make_loss(make_mesh(MeshConfig())))(params, ids, tgt, pos)
-    model = cls(cfg, tp_size=tp, pp_size=pp, pp_microbatches=m)
+    model = cls(cfg, tp_size=tp, pp_size=pp, pp_microbatches=m,
+                pp_schedule=schedule)
     mesh = make_mesh(MeshConfig(dp=dp, pp=pp, tp=tp))
-    sp = jax.device_put(params, model.shardings(mesh))
+    sp = jax.device_put(model.from_canonical(params), model.shardings(mesh))
     l_sh, g_sh = jax.value_and_grad(model.make_loss(mesh))(sp, ids, tgt, pos)
     np.testing.assert_allclose(float(l_sh), float(l_ref), rtol=1e-5)
-    for a, b in zip(jax.tree.flatten(g_sh)[0], jax.tree.flatten(g_ref)[0]):
+    for a, b in zip(jax.tree.flatten(model.to_canonical(g_sh))[0],
+                    jax.tree.flatten(g_ref)[0]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
     print(f"pipeline OK: {family} dp{dp} x pp{pp} x tp{tp} m={m} "
-          f"L={num_layers} loss={float(l_sh):.5f}")
+          f"L={num_layers} schedule={schedule} loss={float(l_sh):.5f}")
 
 
 def main():
@@ -157,6 +160,8 @@ def main():
     check_pipeline(2, 2, 4, 4)
     check_pipeline(1, 4, 4, 8, num_layers=4)       # deep pipe: 4 stages
     check_pipeline(2, 2, 4, 4, family="gpt2")      # second family, 16 dev
+    # interleaved schedule at 4 stages x 2 virtual blocks, 16 devices
+    check_pipeline(1, 4, 4, 8, num_layers=8, schedule="interleaved")
     print("wide-mesh sweep: ALL OK")
 
 
