@@ -180,9 +180,11 @@ MOE_CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=2,
 @pytest.mark.parametrize("name,axes,kw", [
     ("ep2", dict(ep=2), dict(ep_size=2)),
     ("ep2tp2", dict(ep=2, tp=2), dict(ep_size=2, tp_size=2)),
-    ("dp2ep2tp2", dict(dp=2, ep=2, tp=2), dict(ep_size=2, tp_size=2)),
-    ("ep2tp2_sp", dict(ep=2, tp=2),
-     dict(ep_size=2, tp_size=2, sequence_parallel=True)),
+    pytest.param("dp2ep2tp2", dict(dp=2, ep=2, tp=2),
+                 dict(ep_size=2, tp_size=2), marks=pytest.mark.slow),
+    pytest.param("ep2tp2_sp", dict(ep=2, tp=2),
+                 dict(ep_size=2, tp_size=2, sequence_parallel=True),
+                 marks=pytest.mark.slow),
     ("pp2ep2", dict(pp=2, ep=2), dict(pp_size=2, ep_size=2)),
 ])
 def test_gpt2_moe_matches_single_device(name, axes, kw):
@@ -299,8 +301,9 @@ def test_gpt2_decoder_rejects_overlong_buffer():
     ("cp2_ulysses", dict(cp=2), dict(cp_size=2, cp_impl="ulysses")),
     ("cp2_zigzag", dict(cp=2), dict(cp_size=2, cp_layout="zigzag")),
     ("tp2_sp", dict(tp=2), dict(tp_size=2, sequence_parallel=True)),
-    ("dp2cp2tp2_sp", dict(dp=2, cp=2, tp=2),
-     dict(tp_size=2, cp_size=2, sequence_parallel=True)),
+    pytest.param("dp2cp2tp2_sp", dict(dp=2, cp=2, tp=2),
+                 dict(tp_size=2, cp_size=2, sequence_parallel=True),
+                 marks=pytest.mark.slow),
 ])
 def test_gpt2_context_sequence_parallel_matches_vanilla(name, axes, kw):
     """gpt2 on cp (ring/ulysses/zigzag) and Megatron SP meshes — round 3

@@ -132,9 +132,10 @@ def test_validation_errors():
 
 MESHES = [
     ("ep2", dict(dp=1, ep=2, tp=1)),
-    ("ep4", dict(dp=1, ep=4, tp=1)),
+    pytest.param("ep4", dict(dp=1, ep=4, tp=1), marks=pytest.mark.slow),
     ("ep2tp2", dict(dp=1, ep=2, tp=2)),
-    ("dp2ep2tp2", dict(dp=2, ep=2, tp=2)),
+    pytest.param("dp2ep2tp2", dict(dp=2, ep=2, tp=2),
+                 marks=pytest.mark.slow),
 ]
 
 

@@ -49,9 +49,10 @@ MESHES = [
     # (dp, pp, tp, microbatches); 0 microbatches -> pp (minimum schedule)
     ("pp2", 1, 2, 1, 0),
     ("pp4", 1, 4, 1, 0),
-    ("pp2_m8", 1, 2, 1, 8),   # deep pipeline: 8 microbatches of 1
+    pytest.param("pp2_m8", 1, 2, 1, 8,
+                 marks=pytest.mark.slow),  # deep pipe: 8 microbatches of 1
     ("pp2tp2", 1, 2, 2, 0),
-    ("dp2pp2tp2", 2, 2, 2, 4),
+    pytest.param("dp2pp2tp2", 2, 2, 2, 4, marks=pytest.mark.slow),
 ]
 
 
@@ -289,9 +290,12 @@ def test_pp_microbatches_without_pp_raises():
 
 @pytest.mark.parametrize("name,axes,kw", [
     ("pp2_V2", dict(pp=2), dict(pp_size=2)),
-    ("pp2_V2_m4", dict(pp=2), dict(pp_size=2, pp_microbatches=4)),
-    ("pp2tp2_V2_remat", dict(pp=2, tp=2),
-     dict(pp_size=2, tp_size=2, pp_remat_steps=True)),
+    pytest.param("pp2_V2_m4", dict(pp=2),
+                 dict(pp_size=2, pp_microbatches=4),
+                 marks=pytest.mark.slow),
+    pytest.param("pp2tp2_V2_remat", dict(pp=2, tp=2),
+                 dict(pp_size=2, tp_size=2, pp_remat_steps=True),
+                 marks=pytest.mark.slow),
     ("pp4_V2", dict(pp=4), dict(pp_size=4, pp_microbatches=4)),
     ("pp2_V2_cp2_ring", dict(pp=2, cp=2), dict(pp_size=2, cp_size=2)),
 ])
