@@ -186,14 +186,112 @@ class DataLoader:
         return self.epoch(0)
 
 
+@dataclass
+class PackedDataLoader:
+    """Classic packed-stream LM loader — zero padding compute (beyond the
+    reference, which pads each row to the batch max, `dataset.py:40-55`).
+
+    Per epoch: shuffle the documents, frame each as [BOS] + tokens + [EOS],
+    concatenate into one stream, and cut fixed (batch, maxlen) chunks with
+    the shift-by-one target (`target[t] = input[t+1]`; the last target of a
+    row is the next row's first token). Every batch is identical shape with
+    no IGNORE_INDEX padding, so with avg document length << maxlen the
+    per-step useful-token fraction goes from ~avg_len/maxlen to 1.0.
+
+    Semantics deviations from the docs-mode loader, both standard for GPT
+    training and documented here: (a) documents can span chunk boundaries,
+    and attention may cross document boundaries within a row (EOS/BOS
+    separators mark them); (b) position_ids restart per ROW, not per
+    document; (c) no truncation — long documents simply span chunks.
+    Exposes the same interface the train loop consumes (`dataset`,
+    `__len__`, `epoch`).
+    """
+
+    dataset: TokenDataset
+    batch_size: int
+    maxlen: int
+    shuffle: bool = True
+    seed: int = 0
+
+    def __post_init__(self):
+        ds = self.dataset
+        seqs = ds.data[ds.split]
+        # Frame every document ONCE into a cached [BOS]+doc+[EOS] buffer;
+        # each epoch is then a pure gather of shuffled spans (no
+        # per-element Python work on the epoch boundary, where it would
+        # serialize ahead of the prefetch thread).
+        lens = np.fromiter((len(s) for s in seqs), np.int64, len(seqs))
+        self._offsets = np.zeros(len(seqs) + 1, np.int64)
+        np.cumsum(lens + 2, out=self._offsets[1:])
+        self._total = int(self._offsets[-1])
+        if self._total - 1 < self.batch_size * self.maxlen:
+            raise ValueError(
+                f"packed mode needs at least batch_size*maxlen+1 = "
+                f"{self.batch_size * self.maxlen + 1} framed tokens, "
+                f"corpus has {self._total}")
+        self._framed = np.empty(self._total, np.int32)
+        for i, s in enumerate(seqs):
+            o = int(self._offsets[i])
+            self._framed[o] = ds.bos
+            self._framed[o + 1 : o + 1 + len(s)] = s
+            self._framed[o + 1 + len(s)] = ds.eos
+
+    def __len__(self) -> int:
+        return (self._total - 1) // (self.batch_size * self.maxlen)
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        n_docs = len(self._offsets) - 1
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + epoch).permutation(
+                n_docs)
+            stream = np.concatenate(
+                [self._framed[self._offsets[i]:self._offsets[i + 1]]
+                 for i in order])
+        else:
+            stream = self._framed
+        bs, T = self.batch_size, self.maxlen
+        span = bs * T
+        pos = np.tile(np.arange(T, dtype=np.int32)[None, :], (bs, 1))
+        for st in range(0, self._total - 1 - span + 1, span):
+            seg = stream[st : st + span + 1]
+            yield {"input_ids": seg[:-1].reshape(bs, T),
+                   "target_ids": seg[1:].reshape(bs, T),
+                   "position_ids": pos}
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
 def get_dataloader(data_path: str, batch_size: int,
                    ignore_idx: int = IGNORE_INDEX, split: str = "train",
                    maxlen: int = 1000, shuffle: bool = True, seed: int = 0,
                    pad_to: Optional[int] = None,
                    drop_last: Optional[bool] = None,
-                   backend: str = "auto") -> DataLoader:
-    """Reference-parity factory (`dataset.py:58-68`)."""
+                   backend: str = "auto",
+                   data_mode: str = "docs") -> "DataLoader | PackedDataLoader":
+    """Reference-parity factory (`dataset.py:58-68`).
+
+    `data_mode='packed'` returns the zero-padding packed-stream loader
+    instead (training only; see PackedDataLoader)."""
     ds = TokenDataset(data_path, split, maxlen)
+    if data_mode == "packed":
+        # training-only mode; the docs-path knobs cannot take effect — an
+        # explicit non-default request must fail loudly, not silently
+        if split != "train":
+            raise ValueError("data_mode='packed' is a TRAINING data mode; "
+                             "evaluation is per-document (split='validation' "
+                             "uses data_mode='docs')")
+        bad = [name for name, val, dflt in [
+            ("pad_to", pad_to, None), ("drop_last", drop_last, None),
+            ("backend", backend, "auto")] if val != dflt]
+        if bad:
+            raise ValueError(f"data_mode='packed' ignores {bad}; remove "
+                             f"them (chunks are always fixed-shape and "
+                             f"assembled in numpy)")
+        return PackedDataLoader(ds, batch_size, maxlen, shuffle, seed)
+    if data_mode != "docs":
+        raise ValueError(f"data_mode must be 'docs' or 'packed', "
+                         f"got {data_mode!r}")
     if pad_to is None:
         pad_to = maxlen
     if drop_last is None:

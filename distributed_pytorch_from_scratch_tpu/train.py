@@ -174,6 +174,14 @@ def get_train_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("data")
     g.add_argument("--data_path", "-d", type=str, required=True)
+    g.add_argument("--data_mode", choices=["docs", "packed"], default="docs",
+                   help="'docs' = one document per row, padded to maxlen "
+                        "(reference semantics, dataset.py:40-55); 'packed' "
+                        "= concatenate shuffled BOS/EOS-framed documents "
+                        "and cut fixed (batch, maxlen) chunks — zero "
+                        "padding compute (classic GPT packing; documents "
+                        "may span rows and attention may cross doc "
+                        "boundaries within a row)")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -252,7 +260,8 @@ def train(args: argparse.Namespace) -> dict:
     dataloader = get_dataloader(args.data_path, args.batch_size,
                                 IGNORE_INDEX, split="train",
                                 maxlen=maxlen, shuffle=True,
-                                seed=args.random_seed)
+                                seed=args.random_seed,
+                                data_mode=args.data_mode)
     vocab_size = dataloader.dataset.vocab_size
     cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
                       ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
@@ -362,6 +371,13 @@ def train(args: argparse.Namespace) -> dict:
     # with accumulation one optimizer step consumes `accum` batches
     steps_per_epoch = len(dataloader) // accum
     if steps_per_epoch == 0:
+        if args.data_mode == "packed":
+            raise SystemExit(
+                f"packed corpus yields {len(dataloader)} chunks of "
+                f"batch_size*maxlen = {args.batch_size * maxlen} tokens but "
+                f"one optimizer step needs {accum} chunk(s) (grad_accum): "
+                f"zero steps per epoch — reduce --batch_size/--maxlen/"
+                f"--grad_accum")
         raise SystemExit(
             f"dataset has {len(dataloader.dataset)} sequences but one "
             f"optimizer step needs {args.batch_size * accum} "

@@ -128,3 +128,88 @@ def test_preprocess(tmp_path):
     assert set(data) == {"train", "validation"}
     assert all(len(t) <= 200 for t in data["train"] + data["validation"])
     assert len(data["validation"]) >= 1
+
+
+# ---- packed-stream data mode (beyond the reference) ----
+
+
+def test_packed_loader_shapes_and_shift(pipeline):
+    """Every packed batch is exactly (batch, maxlen) with the shift-by-one
+    target across the whole stream — including row boundaries."""
+    dl = get_dataloader(str(pipeline["tokens"]), batch_size=2, maxlen=16,
+                        data_mode="packed", seed=3)
+    batches = list(dl.epoch(0))
+    assert len(batches) == len(dl) and len(batches) > 0
+    for b in batches:
+        assert b["input_ids"].shape == (2, 16)
+        assert b["target_ids"].shape == (2, 16)
+        assert (b["position_ids"] == np.arange(16)[None, :]).all()
+        flat_in = b["input_ids"].reshape(-1)
+        flat_tgt = b["target_ids"].reshape(-1)
+        # within the batch, target is input shifted by one (incl. across rows)
+        np.testing.assert_array_equal(flat_tgt[:-1], flat_in[1:])
+        assert (b["target_ids"] != IGNORE_INDEX).all()  # zero padding
+
+
+def test_packed_loader_covers_corpus_exactly_once(pipeline):
+    """The concatenation of an epoch's inputs reproduces the BOS/EOS-framed
+    shuffled corpus prefix — no token lost, duplicated, or padded."""
+    ds = TokenDataset(str(pipeline["tokens"]), "train", 16)
+    dl = get_dataloader(str(pipeline["tokens"]), batch_size=2, maxlen=16,
+                        data_mode="packed", seed=7)
+    seqs = ds.data["train"]
+    order = np.random.RandomState(7 + 0).permutation(len(seqs))
+    expect = []
+    for i in order:
+        expect.extend([ds.bos] + list(seqs[int(i)]) + [ds.eos])
+    got = np.concatenate([b["input_ids"].reshape(-1)
+                          for b in dl.epoch(0)])
+    np.testing.assert_array_equal(got, np.asarray(expect[: len(got)]))
+    # the drop is at most one chunk + the shift token
+    assert len(expect) - len(got) <= 2 * 16 + 1
+
+
+def test_packed_loader_epochs_differ_and_are_deterministic(pipeline):
+    dl = get_dataloader(str(pipeline["tokens"]), batch_size=2, maxlen=16,
+                        data_mode="packed", seed=5)
+    e0a = next(iter(dl.epoch(0)))["input_ids"]
+    e0b = next(iter(dl.epoch(0)))["input_ids"]
+    e1 = next(iter(dl.epoch(1)))["input_ids"]
+    np.testing.assert_array_equal(e0a, e0b)
+    assert not np.array_equal(e0a, e1)
+
+
+def test_packed_loader_rejects_tiny_corpus(tmp_path):
+    j = tmp_path / "tiny.json"
+    json.dump({"train": [[5, 6]], "validation": [[5]],
+               "special_ids": {BOS_TOKEN: 0, EOS_TOKEN: 1, UNK_TOKEN: 2},
+               "vocab_size": 16}, open(j, "w"))
+    with pytest.raises(ValueError, match="packed mode needs"):
+        get_dataloader(str(j), batch_size=4, maxlen=64, data_mode="packed")
+
+
+def test_cli_train_packed_mode(pipeline, tmp_path):
+    """--data_mode packed end to end through the train CLI (with prefetch +
+    steps_per_dispatch riding the same batch interface)."""
+    from distributed_pytorch_from_scratch_tpu import train as train_mod
+
+    r = train_mod.train(train_mod.get_train_args(
+        ["--data_path", str(pipeline["tokens"]),
+         "--save_dir", str(tmp_path / "ck"),
+         "--data_mode", "packed", "--tp_size", "2", "--dp_size", "2",
+         "--batch_size", "4", "--maxlen", "16",
+         "--attn_dim", "32", "--ffn_dim", "64", "--num_heads", "4",
+         "--num_layers", "2",
+         "--max_steps", "4", "--steps_per_dispatch", "2",
+         "--save_interval", "4", "--log_interval", "2",
+         "--warmup_steps", "2"]))
+    assert r["steps"] == 4 and np.isfinite(r["avg_loss"])
+
+
+def test_packed_loader_rejects_docs_only_knobs(pipeline):
+    with pytest.raises(ValueError, match="TRAINING data mode"):
+        get_dataloader(str(pipeline["tokens"]), 2, maxlen=16,
+                       split="validation", data_mode="packed")
+    with pytest.raises(ValueError, match="ignores"):
+        get_dataloader(str(pipeline["tokens"]), 2, maxlen=16,
+                       backend="native", data_mode="packed")
