@@ -283,7 +283,8 @@ def get_dataloader(data_path: str, batch_size: int,
                              "uses data_mode='docs')")
         bad = [name for name, val, dflt in [
             ("pad_to", pad_to, None), ("drop_last", drop_last, None),
-            ("backend", backend, "auto")] if val != dflt]
+            ("backend", backend, "auto"),
+            ("ignore_idx", ignore_idx, IGNORE_INDEX)] if val != dflt]
         if bad:
             raise ValueError(f"data_mode='packed' ignores {bad}; remove "
                              f"them (chunks are always fixed-shape and "

@@ -392,6 +392,7 @@ def train(args: argparse.Namespace) -> dict:
     # serialize host dispatch with device execution
     accum_loss, n = jnp.zeros((), jnp.float32), start_step
     t_start, tokens_since, steps_since = time.time(), 0, 0
+    useful_since = 0  # non-IGNORE_INDEX targets: real tokens vs padding
     done = False
     shutdown = _ShutdownFlag()
     last_saved = start_step
@@ -494,6 +495,8 @@ def train(args: argparse.Namespace) -> dict:
                         jnp.asarray(window["position_ids"]))
                 n += 1 if accum > 1 else steps_in
                 tokens_since += window["input_ids"].size
+                useful_since += int((window["target_ids"]
+                                     != IGNORE_INDEX).sum())
                 steps_since += steps_in
                 # only DISPATCHED pulls count toward the ms/dispatch wait
                 # metric (dropped partial groups and the end-of-epoch
@@ -508,16 +511,20 @@ def train(args: argparse.Namespace) -> dict:
                     avg = float(accum_loss) / (n - start_step)
                     dt = time.time() - t_start
                     tps = tokens_since / max(dt, 1e-9)
+                    useful = useful_since / max(tokens_since, 1)
                     mfu = (flops_step * steps_since) / max(dt, 1e-9) / peak_flops
                     print(f"step {n}/{args.max_steps} -> avg loss {avg:.4f}, "
-                          f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s, "
+                          f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s "
+                          f"({useful*100:.0f}% useful), "
                           f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f} GiB")
                     writer.scalar("train/ce_loss", avg, n)
                     writer.scalar("train/lr", float(lr), n)
                     writer.scalar("train/tokens_per_sec", tps, n)
+                    writer.scalar("train/useful_token_frac", useful, n)
                     writer.scalar("train/mfu", mfu, n)
                     writer.scalar("device_memory_gib", device_memory_gib(), n)
                     t_start, tokens_since, steps_since = time.time(), 0, 0
+                    useful_since = 0
                 if n // args.save_interval > prev_n // args.save_interval:
                     schedule_save(n)
                 if n >= args.max_steps:
