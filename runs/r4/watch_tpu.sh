@@ -1,17 +1,25 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every ~3 minutes; launch the round-4 hardware
-# session the moment a real (non-cpu) backend answers. Probe log:
-# /tmp/tpu_status_r4.txt. Safe to restart; exits after one successful run.
+# session whenever a real (non-cpu) backend answers. Keeps watching until
+# the session has actually COMPLETED (the "=== done" marker) — a tunnel
+# drop mid-session leaves the idempotent run_experiment.sh resumable, so
+# the watcher re-launches it on the next UP probe. Probe log:
+# /tmp/tpu_status_r4.txt. Safe to restart.
 set -u
 LOG=/tmp/tpu_status_r4.txt
+R=/root/repo/runs/r4
 while true; do
   ts=$(date -u +%FT%TZ)
+  if grep -q "=== done" "$R/session.log" 2>/dev/null; then
+    echo "$ts session complete — watcher exiting" >> "$LOG"
+    exit 0
+  fi
   if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" \
       >/dev/null 2>&1; then
     echo "$ts UP — launching run_experiment.sh" >> "$LOG"
-    bash /root/repo/runs/r4/run_experiment.sh >> /root/repo/runs/r4/launcher.log 2>&1
+    bash "$R/run_experiment.sh" >> "$R/launcher.log" 2>&1
     echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
-    exit 0
+    continue
   fi
   echo "$ts down" >> "$LOG"
   sleep 180
