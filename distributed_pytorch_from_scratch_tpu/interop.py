@@ -1,0 +1,244 @@
+"""Reference-checkpoint interop: import the PyTorch framework's trained
+checkpoints into this framework's format.
+
+The reference saves one torch `state_dict` per TP rank as
+`tprank-{r}_iter-{n}_loss-{x}.pth` (`/root/reference/train.py:121-126`),
+holding that rank's SHARDS of the Megatron-partitioned weights
+(`/root/reference/models/layers.py`):
+
+    embedding.weight                 (vocab/tp, d)   row shard
+    layers.{i}.attn.{wq,wk,wv}.weight  (d/tp, d)     column shard (+ bias (d/tp,))
+    layers.{i}.attn.wo.weight        (d, d/tp)       row shard    (+ bias (d,) replicated)
+    layers.{i}.ffn.{gate,up}_proj.weight (f/tp, d)   column shard (+ bias (f/tp,))
+    layers.{i}.ffn.down_proj.weight  (d, f/tp)       row shard    (+ bias (d,) replicated)
+    layers.{i}.norm{1,2}.scale       (d,)            replicated
+    norm.scale                       (d,)            replicated
+    lm_head.weight                   (vocab/tp, d)   column shard (+ bias (vocab/tp,))
+
+This module reassembles the global tensors from all rank files and maps
+them into this framework's param tree (stacked layers, (idim, odim)
+weight layout — torch's `F.linear` computes `x @ W.T`, ours `x @ W`, so
+every linear weight is transposed; vocab rows/cols are zero-padded to
+`padded_vocab_size`). The result can be saved as a normal checkpoint and
+then trained/evaluated/decoded on ANY mesh — a reference user switches
+frameworks without losing their training run.
+
+CLI:
+    python -m distributed_pytorch_from_scratch_tpu.interop \
+        --ref_ckpt_dir <dir with tprank-*.pth> --iter 16000 \
+        --out_dir <our checkpoint dir> \
+        --attn_dim 512 --ffn_dim 2048 --num_heads 8 --num_layers 12 \
+        --vocab_size 1024 --maxlen 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+from typing import Dict, List
+
+import numpy as np
+
+from .config import ModelConfig
+
+REF_CKPT_RE = re.compile(r"tprank-(\d+)_iter-(\d+)_loss-(.+?)\.pth$")
+
+
+def find_reference_shards(ckpt_dir: str, step: int) -> List[str]:
+    """Per-rank .pth paths for iteration `step`, ordered by rank."""
+    by_rank: Dict[int, str] = {}
+    for p in glob.glob(os.path.join(ckpt_dir, f"tprank-*_iter-{step}_loss-*.pth")):
+        m = REF_CKPT_RE.search(os.path.basename(p))
+        if m and int(m.group(2)) == step:
+            by_rank[int(m.group(1))] = p
+    if not by_rank:
+        raise FileNotFoundError(
+            f"no reference checkpoint files for iter {step} in {ckpt_dir}")
+    ranks = sorted(by_rank)
+    if ranks != list(range(len(ranks))):
+        raise FileNotFoundError(
+            f"reference checkpoint iter {step} has ranks {ranks}; "
+            f"expected contiguous 0..{len(ranks) - 1}")
+    return [by_rank[r] for r in ranks]
+
+
+def reference_iters(ckpt_dir: str) -> List[int]:
+    its = set()
+    for p in glob.glob(os.path.join(ckpt_dir, "tprank-*_iter-*_loss-*.pth")):
+        m = REF_CKPT_RE.search(os.path.basename(p))
+        if m:
+            its.add(int(m.group(2)))
+    return sorted(its)
+
+
+def convert_state_dicts(shards: List[Dict[str, np.ndarray]],
+                        cfg: ModelConfig,
+                        pad_vocab_multiple: int = 1) -> Dict:
+    """Per-rank reference state_dicts (numpy values) -> this framework's
+    global param tree.
+
+    `pad_vocab_multiple`: zero-pad the vocab rows/cols of the embedding
+    and lm_head up to a multiple of this value. Checkpoints reload onto a
+    tp mesh only when the stored vocab dim equals the target model's
+    `padded_vocab_size(tp)`, so for a NON-divisible vocab pass the target
+    tp degree here (a divisible vocab — e.g. the reference's 1024 — needs
+    no padding for any practical tp)."""
+    L = cfg.num_layers
+    m = max(1, pad_vocab_multiple)
+    vp = ((cfg.vocab_size + m - 1) // m) * m
+
+    def cat(key: str, dim: int) -> np.ndarray:
+        return np.concatenate([s[key] for s in shards], axis=dim)
+
+    def col_linear(prefix: str) -> Dict[str, np.ndarray]:
+        # torch column shards (odim/tp, idim) -> global (odim, idim) -> ours
+        # (idim, odim); bias shards (odim/tp,) -> (odim,)
+        out = {"weight": np.ascontiguousarray(cat(f"{prefix}.weight", 0).T)}
+        if f"{prefix}.bias" in shards[0]:
+            out["bias"] = cat(f"{prefix}.bias", 0)
+        return out
+
+    def row_linear(prefix: str) -> Dict[str, np.ndarray]:
+        # torch row shards (odim, idim/tp) -> global (odim, idim) -> ours
+        # (idim, odim); bias replicated -> rank 0's copy
+        out = {"weight": np.ascontiguousarray(cat(f"{prefix}.weight", 1).T)}
+        if f"{prefix}.bias" in shards[0]:
+            out["bias"] = shards[0][f"{prefix}.bias"]
+        return out
+
+    def pad_rows(w: np.ndarray) -> np.ndarray:
+        if w.shape[0] == vp:
+            return w
+        return np.concatenate(
+            [w, np.zeros((vp - w.shape[0],) + w.shape[1:], w.dtype)], axis=0)
+
+    emb = pad_rows(cat("embedding.weight", 0))
+    if emb.shape != (vp, cfg.attn_dim):
+        raise ValueError(f"embedding reassembled to {emb.shape}; expected "
+                         f"({vp}, {cfg.attn_dim}) — do the --attn_dim/"
+                         f"--vocab_size flags match the trained model?")
+
+    def one_layer(i: int) -> Dict:
+        p = f"layers.{i}"
+        return {
+            "wq": col_linear(f"{p}.attn.wq"),
+            "wk": col_linear(f"{p}.attn.wk"),
+            "wv": col_linear(f"{p}.attn.wv"),
+            "wo": row_linear(f"{p}.attn.wo"),
+            "gate_proj": col_linear(f"{p}.ffn.gate_proj"),
+            "up_proj": col_linear(f"{p}.ffn.up_proj"),
+            "down_proj": row_linear(f"{p}.ffn.down_proj"),
+            "norm1": {"scale": shards[0][f"{p}.norm1.scale"]},
+            "norm2": {"scale": shards[0][f"{p}.norm2.scale"]},
+        }
+
+    layers = [one_layer(i) for i in range(L)]
+    # stack per-leaf along the new leading layer dim (lax.scan layout)
+    stacked = {}
+    for mod in layers[0]:
+        stacked[mod] = {k: np.stack([lyr[mod][k] for lyr in layers])
+                        for k in layers[0][mod]}
+
+    lm = col_linear("lm_head")
+    lm["weight"] = np.concatenate(
+        [lm["weight"],
+         np.zeros((cfg.attn_dim, vp - lm["weight"].shape[1]),
+                  lm["weight"].dtype)], axis=1)
+    if "bias" in lm:
+        lm["bias"] = pad_rows(lm["bias"])
+
+    return {
+        "embedding": {"weight": emb},
+        "layers": stacked,
+        "norm": {"scale": shards[0]["norm.scale"]},
+        "lm_head": lm,
+    }
+
+
+def load_reference_checkpoint(ckpt_dir: str, step: int, cfg: ModelConfig,
+                              pad_vocab_multiple: int = 1) -> Dict:
+    """torch .pth rank shards -> this framework's param tree (f32 numpy)."""
+    import torch  # CPU-only use; torch is host-side here
+
+    shards = []
+    for path in find_reference_shards(ckpt_dir, step):
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        shards.append({k: v.float().numpy() for k, v in sd.items()})
+    return convert_state_dicts(shards, cfg, pad_vocab_multiple)
+
+
+def main(argv=None) -> Dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ref_ckpt_dir", required=True,
+                   help="directory holding the reference's tprank-*.pth files")
+    p.add_argument("--iter", type=int, default=None,
+                   help="iteration to import (default: latest found)")
+    p.add_argument("--out_dir", required=True,
+                   help="output directory for this framework's checkpoint")
+    p.add_argument("--attn_dim", type=int, default=512)
+    p.add_argument("--ffn_dim", type=int, default=2048)
+    p.add_argument("--num_heads", type=int, default=8)
+    p.add_argument("--num_layers", type=int, default=12)
+    p.add_argument("--vocab_size", type=int, default=1024)
+    p.add_argument("--maxlen", type=int, default=1000)
+    p.add_argument("--pad_vocab_multiple", type=int, default=1,
+                   help="zero-pad the vocab dim to a multiple of this (set "
+                        "to your target tp degree when vocab_size does not "
+                        "divide it; irrelevant for divisible vocabs)")
+    args = p.parse_args(argv)
+
+    from .models.transformer import Transformer
+    from .training.checkpoint import save_checkpoint
+
+    step = args.iter
+    if step is None:
+        its = reference_iters(args.ref_ckpt_dir)
+        if not its:
+            raise SystemExit(f"no reference checkpoints in "
+                             f"{args.ref_ckpt_dir}")
+        step = its[-1]
+    cfg = ModelConfig(attn_dim=args.attn_dim, ffn_dim=args.ffn_dim,
+                      num_heads=args.num_heads, num_layers=args.num_layers,
+                      vocab_size=args.vocab_size, maxlen=args.maxlen)
+    params = load_reference_checkpoint(args.ref_ckpt_dir, step, cfg,
+                                       args.pad_vocab_multiple)
+    # The template model pads vocab exactly like the converter (tp_size is
+    # only used for the padding arithmetic here; the checkpoint itself is
+    # written as one tp=1 shard file).
+    model = Transformer(cfg, tp_size=max(1, args.pad_vocab_multiple))
+    # shape-check against a real init before writing anything
+    import jax
+
+    template = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    flat_t = {"/".join(map(str, path)): leaf for path, leaf in
+              _walk(template)}
+    flat_p = {"/".join(map(str, path)): leaf for path, leaf in _walk(params)}
+    if set(flat_t) != set(flat_p):
+        raise SystemExit(f"converted tree mismatch: missing "
+                         f"{sorted(set(flat_t) - set(flat_p))}, extra "
+                         f"{sorted(set(flat_p) - set(flat_t))}")
+    for k in flat_t:
+        if tuple(flat_t[k].shape) != tuple(flat_p[k].shape):
+            raise SystemExit(f"shape mismatch at {k}: reference gives "
+                             f"{flat_p[k].shape}, model expects "
+                             f"{flat_t[k].shape}")
+    paths = save_checkpoint(args.out_dir, step, float("nan"), params,
+                            model.specs(), tp_size=1)
+    print(f"imported reference iter {step} "
+          f"({len(find_reference_shards(args.ref_ckpt_dir, step))} rank "
+          f"shard(s)) -> {paths[0]}")
+    return params
+
+
+def _walk(tree, path=()):
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _walk(tree[k], path + (k,))
+    else:
+        yield path, tree
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,11 @@ while true; do
       >/dev/null 2>&1; then
     echo "$ts UP — launching run_experiment.sh" >> "$LOG"
     bash "$R/run_experiment.sh" >> "$R/launcher.log" 2>&1
-    echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
+    rc=$?
+    echo "$(date -u +%FT%TZ) experiment script exited rc=$rc" >> "$LOG"
+    # pace re-launch attempts too (a flapping tunnel can pass the probe
+    # yet fail the script's own stricter check within seconds)
+    sleep 180
     continue
   fi
   echo "$ts down" >> "$LOG"

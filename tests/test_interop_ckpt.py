@@ -1,0 +1,200 @@
+"""Reference-checkpoint import (interop.py): torch per-rank .pth shards ->
+this framework's param tree and checkpoint format.
+
+The fixtures build state_dicts with the reference's EXACT naming and
+shard layouts (`/root/reference/models/layers.py` — column shards
+(odim/tp, idim), row shards (odim, idim/tp), replicated row bias and
+norms, vocab-row-sharded embedding/lm_head) from known full tensors, so
+the converter's concat/transpose/pad logic is verified against ground
+truth without executing any reference code. A forward/loss drive on the
+imported params proves the result is a usable model, and the CLI path
+round-trips through the normal checkpoint machinery onto a tp=2 mesh.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from distributed_pytorch_from_scratch_tpu import MeshConfig, make_mesh
+from distributed_pytorch_from_scratch_tpu.config import ModelConfig
+from distributed_pytorch_from_scratch_tpu.interop import (
+    convert_state_dicts, load_reference_checkpoint, main as interop_main)
+from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=2,
+                  vocab_size=96, maxlen=64)
+
+
+def make_full_tensors(cfg, rng):
+    d, f, L, V = cfg.attn_dim, cfg.ffn_dim, cfg.num_layers, cfg.vocab_size
+    t = lambda *shape: rng.standard_normal(shape).astype(np.float32)
+    full = {"embedding.weight": t(V, d), "norm.scale": t(d),
+            "lm_head.weight": t(V, d), "lm_head.bias": t(V)}
+    for i in range(L):
+        p = f"layers.{i}"
+        for name in ("wq", "wk", "wv"):
+            full[f"{p}.attn.{name}.weight"] = t(d, d)   # torch (odim, idim)
+            full[f"{p}.attn.{name}.bias"] = t(d)
+        full[f"{p}.attn.wo.weight"] = t(d, d)
+        full[f"{p}.attn.wo.bias"] = t(d)
+        full[f"{p}.ffn.gate_proj.weight"] = t(f, d)
+        full[f"{p}.ffn.gate_proj.bias"] = t(f)
+        full[f"{p}.ffn.up_proj.weight"] = t(f, d)
+        full[f"{p}.ffn.up_proj.bias"] = t(f)
+        full[f"{p}.ffn.down_proj.weight"] = t(d, f)
+        full[f"{p}.ffn.down_proj.bias"] = t(d)
+        full[f"{p}.norm1.scale"] = t(d)
+        full[f"{p}.norm2.scale"] = t(d)
+    return full
+
+
+def shard_reference(full, cfg, tp):
+    """Split full tensors into per-rank state_dicts exactly the way the
+    reference's parallel layers hold them."""
+    col_w = lambda w, r: np.split(w, tp, axis=0)[r]     # (odim/tp, idim)
+    row_w = lambda w, r: np.split(w, tp, axis=1)[r]     # (odim, idim/tp)
+    shards = []
+    for r in range(tp):
+        s = {}
+        for k, v in full.items():
+            if k == "embedding.weight" or k.startswith("lm_head"):
+                s[k] = np.split(v, tp, axis=0)[r]       # vocab shards
+            elif k.endswith(("norm1.scale", "norm2.scale")) or k == "norm.scale":
+                s[k] = v                                  # replicated
+            elif ".wo." in k or ".down_proj." in k:
+                s[k] = row_w(v, r) if k.endswith("weight") else v  # row: full bias
+            elif k.endswith("weight"):
+                s[k] = col_w(v, r)
+            else:
+                s[k] = np.split(v, tp, axis=0)[r]       # column bias shards
+        shards.append(s)
+    return shards
+
+
+@pytest.mark.parametrize("tp", [1, 2, 4])
+def test_convert_reassembles_ground_truth(tp):
+    rng = np.random.default_rng(0)
+    full = make_full_tensors(CFG, rng)
+    params = convert_state_dicts(shard_reference(full, CFG, tp), CFG)
+
+    np.testing.assert_array_equal(params["embedding"]["weight"],
+                                  full["embedding.weight"])
+    np.testing.assert_array_equal(params["norm"]["scale"], full["norm.scale"])
+    # linears transpose into the (idim, odim) layout
+    np.testing.assert_array_equal(params["lm_head"]["weight"],
+                                  full["lm_head.weight"].T)
+    np.testing.assert_array_equal(params["lm_head"]["bias"],
+                                  full["lm_head.bias"])
+    for i in range(CFG.num_layers):
+        p = f"layers.{i}"
+        for mod, ref in [("wq", "attn.wq"), ("wo", "attn.wo"),
+                         ("gate_proj", "ffn.gate_proj"),
+                         ("down_proj", "ffn.down_proj")]:
+            np.testing.assert_array_equal(
+                params["layers"][mod]["weight"][i],
+                full[f"{p}.{ref}.weight"].T, err_msg=f"{p}.{ref}")
+            np.testing.assert_array_equal(
+                params["layers"][mod]["bias"][i], full[f"{p}.{ref}.bias"])
+        np.testing.assert_array_equal(params["layers"]["norm1"]["scale"][i],
+                                      full[f"{p}.norm1.scale"])
+
+
+def test_convert_is_tp_invariant():
+    """The same full tensors imported from tp=1 and tp=4 shardings must
+    produce identical trees (shard reassembly is lossless)."""
+    rng = np.random.default_rng(1)
+    full = make_full_tensors(CFG, rng)
+    p1 = convert_state_dicts(shard_reference(full, CFG, 1), CFG)
+    p4 = convert_state_dicts(shard_reference(full, CFG, 4), CFG)
+    import jax
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_padded_vocab_import():
+    """vocab 90 imported with pad_vocab_multiple=4 -> 92 rows/cols of
+    which the last 2 are REAL zero padding (the layout a tp=4 target model
+    expects — padded_vocab_size(4) == 92)."""
+    cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=1,
+                      vocab_size=90, maxlen=32)
+    rng = np.random.default_rng(2)
+    full = make_full_tensors(cfg, rng)
+    params = convert_state_dicts(shard_reference(full, cfg, 2), cfg,
+                                 pad_vocab_multiple=4)
+    assert cfg.padded_vocab_size(4) == 92
+    assert params["embedding"]["weight"].shape == (92, 32)
+    assert params["lm_head"]["weight"].shape == (32, 92)
+    assert params["lm_head"]["bias"].shape == (92,)
+    np.testing.assert_array_equal(params["embedding"]["weight"][:90],
+                                  full["embedding.weight"])
+    assert (params["embedding"]["weight"][90:] == 0).all()
+    assert (params["lm_head"]["weight"][:, 90:] == 0).all()
+    assert (params["lm_head"]["bias"][90:] == 0).all()
+
+    # and the padded import actually drives a tp=4 model
+    import jax
+    import jax.numpy as jnp
+    model = Transformer(cfg, tp_size=4)
+    mesh = make_mesh(MeshConfig(tp=4))
+    sp = jax.device_put(jax.tree.map(jnp.asarray, params),
+                        model.shardings(mesh))
+    ids = jax.random.randint(jax.random.key(1), (2, 8), 0, 90)
+    pos = jnp.tile(jnp.arange(8)[None, :], (2, 1))
+    loss = model.make_loss(mesh)(sp, ids, ids, pos)
+    assert np.isfinite(float(loss))
+
+
+def test_imported_params_drive_the_model():
+    """Imported params run a forward + loss on a tp=2 mesh — shape-exact
+    and finite (the end-to-end 'switch frameworks' check)."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    full = make_full_tensors(CFG, rng)
+    params = convert_state_dicts(shard_reference(full, CFG, 2), CFG)
+    params = jax.tree.map(jnp.asarray, params)
+
+    model = Transformer(CFG, tp_size=2)
+    mesh = make_mesh(MeshConfig(tp=2))
+    sp = jax.device_put(params, model.shardings(mesh))
+    ids = jax.random.randint(jax.random.key(0), (2, 16), 0, CFG.vocab_size)
+    pos = jnp.tile(jnp.arange(16)[None, :], (2, 1))
+    logits = model.make_forward(mesh)(sp, ids, pos)
+    assert logits.shape == (2, 16, model.vocab_padded)
+    assert bool(jnp.isfinite(logits).all())
+    loss = model.make_loss(mesh)(sp, ids, ids, pos)
+    assert np.isfinite(float(loss))
+
+
+def test_cli_import_roundtrip(tmp_path):
+    """torch .pth rank files -> interop CLI -> our checkpoint -> reload on
+    a tp=2 mesh; values identical to the direct conversion."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+        load_checkpoint)
+
+    rng = np.random.default_rng(4)
+    full = make_full_tensors(CFG, rng)
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    for r, sd in enumerate(shard_reference(full, CFG, 2)):
+        torch.save({k: torch.from_numpy(v) for k, v in sd.items()},
+                   ref_dir / f"tprank-{r}_iter-500_loss-3.1400.pth")
+
+    out_dir = tmp_path / "ours"
+    interop_main(["--ref_ckpt_dir", str(ref_dir), "--out_dir", str(out_dir),
+                  "--attn_dim", "32", "--ffn_dim", "64", "--num_heads", "4",
+                  "--num_layers", "2", "--vocab_size", "96",
+                  "--maxlen", "64"])
+
+    model = Transformer(CFG)
+    template = model.init(jax.random.key(9))
+    loaded, _, step = load_checkpoint(str(out_dir), 500, template,
+                                      model.specs())
+    assert step == 500
+    direct = load_reference_checkpoint(str(ref_dir), 500, CFG)
+    for a, b in zip(jax.tree.leaves(loaded), jax.tree.leaves(direct)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
