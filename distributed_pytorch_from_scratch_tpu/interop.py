@@ -42,17 +42,12 @@ from typing import Dict, List
 import numpy as np
 
 from .config import ModelConfig
-
-REF_CKPT_RE = re.compile(r"tprank-(\d+)_iter-(\d+)_loss-(.+?)\.pth$")
+from .training.checkpoint import find_rank_shards
 
 
 def find_reference_shards(ckpt_dir: str, step: int) -> List[str]:
     """Per-rank .pth paths for iteration `step`, ordered by rank."""
-    by_rank: Dict[int, str] = {}
-    for p in glob.glob(os.path.join(ckpt_dir, f"tprank-*_iter-{step}_loss-*.pth")):
-        m = REF_CKPT_RE.search(os.path.basename(p))
-        if m and int(m.group(2)) == step:
-            by_rank[int(m.group(1))] = p
+    by_rank = find_rank_shards(ckpt_dir, step, ext="pth")
     if not by_rank:
         raise FileNotFoundError(
             f"no reference checkpoint files for iter {step} in {ckpt_dir}")
@@ -65,9 +60,10 @@ def find_reference_shards(ckpt_dir: str, step: int) -> List[str]:
 
 
 def reference_iters(ckpt_dir: str) -> List[int]:
+    pat = re.compile(r"tprank-(\d+)_iter-(\d+)_loss-(.+?)\.pth$")
     its = set()
     for p in glob.glob(os.path.join(ckpt_dir, "tprank-*_iter-*_loss-*.pth")):
-        m = REF_CKPT_RE.search(os.path.basename(p))
+        m = pat.search(os.path.basename(p))
         if m:
             its.add(int(m.group(2)))
     return sorted(its)
@@ -114,11 +110,16 @@ def convert_state_dicts(shards: List[Dict[str, np.ndarray]],
         return np.concatenate(
             [w, np.zeros((vp - w.shape[0],) + w.shape[1:], w.dtype)], axis=0)
 
-    emb = pad_rows(cat("embedding.weight", 0))
-    if emb.shape != (vp, cfg.attn_dim):
-        raise ValueError(f"embedding reassembled to {emb.shape}; expected "
-                         f"({vp}, {cfg.attn_dim}) — do the --attn_dim/"
-                         f"--vocab_size flags match the trained model?")
+    raw = cat("embedding.weight", 0)
+    # exact-match BEFORE padding: an over-declared --vocab_size would
+    # otherwise be silently zero-filled into "real" vocab rows, and an
+    # under-declared one would crash with an opaque negative-dim error
+    if raw.shape != (cfg.vocab_size, cfg.attn_dim):
+        raise ValueError(f"embedding reassembled to {raw.shape}; expected "
+                         f"({cfg.vocab_size}, {cfg.attn_dim}) — do the "
+                         f"--attn_dim/--vocab_size flags match the trained "
+                         f"model?")
+    emb = pad_rows(raw)
 
     def one_layer(i: int) -> Dict:
         p = f"layers.{i}"
@@ -142,6 +143,9 @@ def convert_state_dicts(shards: List[Dict[str, np.ndarray]],
                         for k in layers[0][mod]}
 
     lm = col_linear("lm_head")
+    if lm["weight"].shape != (cfg.attn_dim, cfg.vocab_size):
+        raise ValueError(f"lm_head reassembled to {lm['weight'].shape}; "
+                         f"expected ({cfg.attn_dim}, {cfg.vocab_size})")
     lm["weight"] = np.concatenate(
         [lm["weight"],
          np.zeros((cfg.attn_dim, vp - lm["weight"].shape[1]),

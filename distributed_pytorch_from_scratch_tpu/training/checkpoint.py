@@ -198,17 +198,29 @@ def _unflatten_into(template: Any, flat: Dict[str, np.ndarray], prefix: str) -> 
     return jax.tree.unflatten(treedef, leaves)
 
 
+def find_rank_shards(ckpt_dir: str, step: int, ext: str = "npz"
+                     ) -> Dict[int, str]:
+    """{rank: path} for `tprank-{r}_iter-{step}_loss-*.{ext}` files — the
+    single owner of the reference filename contract
+    (`/root/reference/train.py:121-126`), shared by the npz loader and the
+    torch-checkpoint importer (interop.py, ext='pth')."""
+    pat = re.compile(rf"tprank-(\d+)_iter-(\d+)_loss-(.+?)\.{ext}$")
+    rank_files: Dict[int, str] = {}
+    for p in glob.glob(os.path.join(ckpt_dir,
+                                    f"tprank-*_iter-{step}_loss-*.{ext}")):
+        m = pat.search(os.path.basename(p))
+        if m and int(m.group(2)) == step:
+            rank_files[int(m.group(1))] = p
+    return rank_files
+
+
 def load_checkpoint(save_dir: str, step: int, params_template: Any,
                     specs: Any, with_opt: bool = False):
     """Reassemble global arrays from all per-rank shards of iteration `step`.
 
     Returns (params, opt_state | None, step).
     """
-    rank_files = {}
-    for p in glob.glob(os.path.join(save_dir, f"tprank-*_iter-{step}_loss-*.npz")):
-        m = CKPT_RE.search(os.path.basename(p))
-        if m and int(m.group(2)) == step:
-            rank_files[int(m.group(1))] = p
+    rank_files = find_rank_shards(save_dir, step)
     if not rank_files:
         raise FileNotFoundError(f"no checkpoint for iter {step} in {save_dir}")
     any_rank = next(iter(rank_files))

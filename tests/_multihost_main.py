@@ -85,6 +85,63 @@ def main() -> None:
     assert np.isfinite(loss), loss
     print(f"MULTIHOST-OK process={process_id} loss={loss:.6f}", flush=True)
 
+    # Second step: ring context parallelism ACROSS the process boundary.
+    # With 4 local devices per process, the cp=2 groups of a cp2 x tp4 mesh
+    # place each ring peer on a different process, so the ring's
+    # collective-permutes traverse the inter-process (DCN-analogue) link —
+    # the reference's NCCL backend never leaves one host
+    # (`/root/reference/utils.py:23`, single-host mp.spawn).
+    cp_model = Transformer(cfg, tp_size=4, cp_size=2)
+    cp_mesh = make_mesh(MeshConfig(cp=2, tp=4))
+    cp_params = jax.jit(cp_model.init,
+                       out_shardings=cp_model.shardings(cp_mesh))(
+        jax.random.key(0))
+    cp_batch_sh = NamedSharding(cp_mesh, P(("dp", "ep"), "cp"))
+    half = t // 2
+    col = half * process_id
+
+    def dist_cols(global_np):
+        # every batch row is cp-sharded over the sequence dim; this process
+        # owns sequence columns [col, col+half)
+        return jax.make_array_from_process_local_data(
+            cp_batch_sh, global_np[:, col:col + half])
+
+    cp_step = build_train_step(cp_model, cp_mesh,
+                               OptimizerConfig(lr=1e-3, warmup_steps=2,
+                                               max_steps=10))
+    _, _, cp_loss = cp_step(cp_params, init_adam_state(cp_params),
+                            dist_cols(ids_global), dist_cols(tgt_global),
+                            dist_cols(pos_global))
+    cp_loss = float(jax.block_until_ready(cp_loss))
+    assert np.isfinite(cp_loss), cp_loss
+    print(f"MULTIHOST-CP-OK process={process_id} loss={cp_loss:.6f}",
+          flush=True)
+
+    # Third step: the pipeline ACROSS the process boundary — stage 0 on
+    # process 0's devices, stage 1 on process 1's, activations ppermuting
+    # between hosts each schedule step.
+    pp_model = Transformer(cfg, tp_size=4, pp_size=2, pp_microbatches=2)
+    pp_mesh = make_mesh(MeshConfig(pp=2, tp=4))
+    pp_params = jax.jit(pp_model.init,
+                       out_shardings=pp_model.shardings(pp_mesh))(
+        jax.random.key(0))
+    pp_batch_sh = NamedSharding(pp_mesh, P(("dp", "ep"), "cp"))
+
+    def dist_full(global_np):
+        # batch replicated over pp: both processes provide the full array
+        return jax.make_array_from_process_local_data(pp_batch_sh, global_np)
+
+    pp_step = build_train_step(pp_model, pp_mesh,
+                               OptimizerConfig(lr=1e-3, warmup_steps=2,
+                                               max_steps=10))
+    _, _, pp_loss = pp_step(pp_params, init_adam_state(pp_params),
+                            dist_full(ids_global), dist_full(tgt_global),
+                            dist_full(pos_global))
+    pp_loss = float(jax.block_until_ready(pp_loss))
+    assert np.isfinite(pp_loss), pp_loss
+    print(f"MULTIHOST-PP-OK process={process_id} loss={pp_loss:.6f}",
+          flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -13,7 +13,8 @@ round-trips through the normal checkpoint machinery onto a tp=2 mesh.
 
 import numpy as np
 import pytest
-import torch
+
+torch = pytest.importorskip("torch")  # host-side only; not a package dep
 
 from distributed_pytorch_from_scratch_tpu import MeshConfig, make_mesh
 from distributed_pytorch_from_scratch_tpu.config import ModelConfig
@@ -198,3 +199,17 @@ def test_cli_import_roundtrip(tmp_path):
     direct = load_reference_checkpoint(str(ref_dir), 500, CFG)
     for a, b in zip(jax.tree.leaves(loaded), jax.tree.leaves(direct)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_import_rejects_mismatched_vocab():
+    """An over- or under-declared --vocab_size must fail with a diagnostic,
+    never silently zero-fill 'real' vocab rows."""
+    import dataclasses
+
+    rng = np.random.default_rng(5)
+    full = make_full_tensors(CFG, rng)
+    shards = shard_reference(full, CFG, 2)
+    for wrong_vocab in (128, 64):
+        wrong = dataclasses.replace(CFG, vocab_size=wrong_vocab)
+        with pytest.raises(ValueError, match="flags match"):
+            convert_state_dicts(shards, wrong)

@@ -47,9 +47,14 @@ def test_two_process_train_step():
         assert p.returncode == 0, f"stdout:\n{out}\nstderr:\n{err}"
         outs.append(out)
 
-    losses = []
-    for pid, out in enumerate(outs):
-        m = re.search(rf"MULTIHOST-OK process={pid} loss=([0-9.]+)", out)
-        assert m, out
-        losses.append(float(m.group(1)))
-    assert losses[0] == losses[1], losses
+    # three cross-process configs: dp x tp train step, ring CP with its
+    # collective-permutes crossing the process boundary, and a 2-stage
+    # pipeline with one stage per process — both processes must report
+    # identical finite losses for each (the cross-process collectives ran)
+    for tag in ("MULTIHOST-OK", "MULTIHOST-CP-OK", "MULTIHOST-PP-OK"):
+        losses = []
+        for pid, out in enumerate(outs):
+            m = re.search(rf"{tag} process={pid} loss=([0-9.]+)", out)
+            assert m, (tag, out)
+            losses.append(float(m.group(1)))
+        assert losses[0] == losses[1], (tag, losses)
