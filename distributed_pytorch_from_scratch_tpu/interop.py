@@ -1,5 +1,7 @@
-"""Reference-checkpoint interop: import the PyTorch framework's trained
-checkpoints into this framework's format.
+"""Reference-checkpoint interop, BOTH directions: import the PyTorch
+framework's trained checkpoints into this framework's format, or export
+ours back into the reference's per-rank `.pth` layout (which its
+`test.py`/`train.py` consume unchanged).
 
 The reference saves one torch `state_dict` per TP rank as
 `tprank-{r}_iter-{n}_loss-{x}.pth` (`/root/reference/train.py:121-126`),
@@ -23,12 +25,17 @@ every linear weight is transposed; vocab rows/cols are zero-padded to
 then trained/evaluated/decoded on ANY mesh — a reference user switches
 frameworks without losing their training run.
 
-CLI:
+CLI (model-shape flags shared by both directions):
+    # reference -> ours
     python -m distributed_pytorch_from_scratch_tpu.interop \
         --ref_ckpt_dir <dir with tprank-*.pth> --iter 16000 \
         --out_dir <our checkpoint dir> \
         --attn_dim 512 --ffn_dim 2048 --num_heads 8 --num_layers 12 \
         --vocab_size 1024 --maxlen 1000
+    # ours -> reference (any reference TP degree)
+    python -m distributed_pytorch_from_scratch_tpu.interop --direction export \
+        --our_ckpt_dir <dir with tprank-*.npz> --export_tp 4 \
+        --out_dir <reference checkpoint dir> [same shape flags]
 """
 
 from __future__ import annotations
@@ -42,7 +49,7 @@ from typing import Dict, List
 import numpy as np
 
 from .config import ModelConfig
-from .training.checkpoint import find_rank_shards
+from .training.checkpoint import CKPT_RE, find_rank_shards
 
 
 def find_reference_shards(ckpt_dir: str, step: int) -> List[str]:
@@ -173,14 +180,127 @@ def load_reference_checkpoint(ckpt_dir: str, step: int, cfg: ModelConfig,
     return convert_state_dicts(shards, cfg, pad_vocab_multiple)
 
 
+def export_state_dicts(params: Dict, cfg: ModelConfig,
+                       tp: int) -> List[Dict[str, np.ndarray]]:
+    """This framework's param tree -> per-rank reference state_dicts — the
+    exact inverse of `convert_state_dicts`, so a model trained here can be
+    evaluated (or trained further) by the reference's `test.py`/`train.py`.
+
+    Only the reference-expressible feature set exports: the llama family,
+    MHA (no GQA), dense FFN (no MoE). Vocab padding rows/cols are dropped
+    (they carry no probability mass); the vocab must divide `tp` like the
+    reference requires (`/root/reference/models/layers.py:117`)."""
+    L, V, d = cfg.num_layers, cfg.vocab_size, cfg.attn_dim
+    if cfg.num_experts:
+        raise ValueError("MoE checkpoints cannot export: the reference's "
+                         "FFN is dense (no router/experts)")
+    if cfg.kv_heads != cfg.num_heads:
+        raise ValueError("GQA checkpoints cannot export: the reference is "
+                         "MHA-only (num_kv_heads == num_heads)")
+    # mirror the reference's own construction asserts so a bad tp fails
+    # HERE with the offending flag, not in np.split or on the reference's
+    # side after the files shipped (`/root/reference/models/model.py:55`,
+    # `layers.py:69,25,117`)
+    if tp < 1:
+        raise ValueError(f"export tp must be >= 1, got {tp}")
+    for what, size in [("vocab_size", V), ("num_heads", cfg.num_heads),
+                       ("attn_dim", d), ("ffn_dim", cfg.ffn_dim)]:
+        if size % tp != 0:
+            raise ValueError(f"tp {tp} must divide {what} {size} for the "
+                             f"reference's partitioning")
+    np_ = lambda a: np.asarray(a, np.float32)
+
+    def col_shards(w, b, r, unpad_to=None):
+        # ours (idim, odim[+pad]) -> torch (odim, idim) shard r over dim 0;
+        # `unpad_to` drops trailing padded output rows (lm_head only —
+        # never inferred from sizes: ffn_dim may exceed vocab_size)
+        wt = np_(w).T
+        if unpad_to is not None:
+            wt = wt[:unpad_to]
+        out = {"weight": np.ascontiguousarray(np.split(wt, tp, axis=0)[r])}
+        if b is not None:
+            bb = np_(b)
+            if unpad_to is not None:
+                bb = bb[:unpad_to]
+            out["bias"] = np.split(bb, tp, axis=0)[r]
+        return out
+
+    def row_shards(w, b, r):
+        wt = np_(w).T
+        out = {"weight": np.ascontiguousarray(np.split(wt, tp, axis=1)[r])}
+        if b is not None:
+            out["bias"] = np_(b)  # replicated full bias
+        return out
+
+    lyr = params["layers"]
+    get = lambda mod, k, i: lyr[mod][k][i] if k in lyr[mod] else None
+    shards = []
+    for r in range(tp):
+        sd: Dict[str, np.ndarray] = {
+            "embedding.weight": np.split(
+                np_(params["embedding"]["weight"])[:V], tp, axis=0)[r],
+            "norm.scale": np_(params["norm"]["scale"]),
+        }
+        sd.update({f"lm_head.{k}": v for k, v in col_shards(
+            params["lm_head"]["weight"],
+            params["lm_head"].get("bias"), r, unpad_to=V).items()})
+        for i in range(L):
+            p = f"layers.{i}"
+            for mod, ref, kind in [("wq", "attn.wq", "col"),
+                                   ("wk", "attn.wk", "col"),
+                                   ("wv", "attn.wv", "col"),
+                                   ("wo", "attn.wo", "row"),
+                                   ("gate_proj", "ffn.gate_proj", "col"),
+                                   ("up_proj", "ffn.up_proj", "col"),
+                                   ("down_proj", "ffn.down_proj", "row")]:
+                fn = col_shards if kind == "col" else row_shards
+                for k, v in fn(lyr[mod]["weight"][i],
+                               get(mod, "bias", i), r).items():
+                    sd[f"{p}.{ref}.{k}"] = v
+            sd[f"{p}.norm1.scale"] = np_(lyr["norm1"]["scale"][i])
+            sd[f"{p}.norm2.scale"] = np_(lyr["norm2"]["scale"][i])
+        shards.append(sd)
+    return shards
+
+
+def export_reference_checkpoint(params: Dict, cfg: ModelConfig, tp: int,
+                                out_dir: str, step: int,
+                                loss: float = 0.0) -> List[str]:
+    """Write per-rank `tprank-{r}_iter-{step}_loss-{loss:.4f}.pth` files
+    the reference's `test.py` discovers by its filename regex
+    (`/root/reference/test.py:94-98`)."""
+    import torch
+
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    for r, sd in enumerate(export_state_dicts(params, cfg, tp)):
+        path = os.path.join(out_dir,
+                            f"tprank-{r}_iter-{step}_loss-{loss:.4f}.pth")
+        torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
+                    for k, v in sd.items()}, path)
+        paths.append(path)
+    return paths
+
+
 def main(argv=None) -> Dict:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--ref_ckpt_dir", required=True,
-                   help="directory holding the reference's tprank-*.pth files")
+    p.add_argument("--direction", choices=["import", "export"],
+                   default="import",
+                   help="'import' = reference .pth -> our checkpoint; "
+                        "'export' = our checkpoint -> reference .pth (the "
+                        "reference's test.py/train.py can then consume it)")
+    p.add_argument("--ref_ckpt_dir", default=None,
+                   help="import: directory holding the reference's "
+                        "tprank-*.pth files")
+    p.add_argument("--our_ckpt_dir", default=None,
+                   help="export: directory holding this framework's "
+                        "tprank-*.npz checkpoint")
+    p.add_argument("--export_tp", type=int, default=1,
+                   help="export: how many reference TP rank files to write")
     p.add_argument("--iter", type=int, default=None,
-                   help="iteration to import (default: latest found)")
+                   help="iteration to convert (default: latest found)")
     p.add_argument("--out_dir", required=True,
-                   help="output directory for this framework's checkpoint")
+                   help="output directory for the converted checkpoint")
     p.add_argument("--attn_dim", type=int, default=512)
     p.add_argument("--ffn_dim", type=int, default=2048)
     p.add_argument("--num_heads", type=int, default=8)
@@ -194,8 +314,45 @@ def main(argv=None) -> Dict:
     args = p.parse_args(argv)
 
     from .models.transformer import Transformer
-    from .training.checkpoint import save_checkpoint
+    from .training.checkpoint import (latest_step, load_checkpoint,
+                                      save_checkpoint)
 
+    cfg = ModelConfig(attn_dim=args.attn_dim, ffn_dim=args.ffn_dim,
+                      num_heads=args.num_heads, num_layers=args.num_layers,
+                      vocab_size=args.vocab_size, maxlen=args.maxlen)
+
+    if args.direction == "export":
+        import jax
+
+        if not args.our_ckpt_dir:
+            raise SystemExit("--direction export needs --our_ckpt_dir")
+        step = args.iter
+        if step is None:
+            step = latest_step(args.our_ckpt_dir)
+            if step is None:
+                raise SystemExit(f"no checkpoints in {args.our_ckpt_dir}")
+        model = Transformer(cfg)
+        # shape-only template: load_checkpoint uses it for tree structure
+        template = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+        params, _, _ = load_checkpoint(args.our_ckpt_dir, step, template,
+                                       model.specs())
+        params = jax.tree.map(np.asarray, params)
+        # carry the real loss metadata from our filename into the exported
+        # names (the reference's convention encodes it there)
+        src = find_rank_shards(args.our_ckpt_dir, step)
+        m = CKPT_RE.search(os.path.basename(src[min(src)]))
+        try:
+            loss = float(m.group(3)) if m else 0.0
+        except ValueError:
+            loss = 0.0  # e.g. 'nan' from an imported checkpoint
+        paths = export_reference_checkpoint(params, cfg, args.export_tp,
+                                            args.out_dir, step, loss=loss)
+        print(f"exported iter {step} -> {len(paths)} reference rank "
+              f"file(s), first: {paths[0]}")
+        return params
+
+    if not args.ref_ckpt_dir:
+        raise SystemExit("--direction import needs --ref_ckpt_dir")
     step = args.iter
     if step is None:
         its = reference_iters(args.ref_ckpt_dir)
@@ -203,9 +360,6 @@ def main(argv=None) -> Dict:
             raise SystemExit(f"no reference checkpoints in "
                              f"{args.ref_ckpt_dir}")
         step = its[-1]
-    cfg = ModelConfig(attn_dim=args.attn_dim, ffn_dim=args.ffn_dim,
-                      num_heads=args.num_heads, num_layers=args.num_layers,
-                      vocab_size=args.vocab_size, maxlen=args.maxlen)
     params = load_reference_checkpoint(args.ref_ckpt_dir, step, cfg,
                                        args.pad_vocab_multiple)
     # The template model pads vocab exactly like the converter (tp_size is
