@@ -106,6 +106,10 @@ def get_eval_args(argv=None) -> argparse.Namespace:
     g.add_argument("--decode_top_k", type=int, default=0,
                    help="with --temperature > 0: sample from the k most "
                         "likely tokens (0 = full distribution)")
+    g.add_argument("--decode_top_p", type=float, default=0.0,
+                   help="with --temperature > 0: nucleus sampling — keep "
+                        "the smallest set of tokens whose probability mass "
+                        "reaches p (0 = off; composes with --decode_top_k)")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -121,6 +125,9 @@ def get_eval_args(argv=None) -> argparse.Namespace:
         # fail at parse time, not after the multi-checkpoint val sweep
         p.error("--temperature requires the KV-cache decoder "
                 "(drop --no_kv_cache)")
+    if (args.decode_top_k or args.decode_top_p) and not args.temperature:
+        p.error("--decode_top_k/--decode_top_p only shape SAMPLED decoding; "
+                "set --temperature > 0 (greedy ignores them)")
     return args
 
 
@@ -199,6 +206,7 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
                   use_kv_cache: bool = True,
                   temperature: float = 0.0,
                   top_k: int = 0,
+                  top_p: float = 0.0,
                   seed: int = 0) -> List[Tuple[str, str]]:
     texts = [t.strip() for t in prompts]
     encoded = {t: tokenizer.encode(t).ids for t in texts}
@@ -224,7 +232,8 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
         # the mixed prompt lengths (models/decode.py). The reference loops
         # prompts AND tokens (`test.py:141-161`).
         decoder = GreedyDecoder(model, mesh, buf_len,
-                                temperature=temperature, top_k=top_k)
+                                temperature=temperature, top_k=top_k,
+                                top_p=top_p)
         gens = decoder.decode_batch(
             params, [[bos_id] + encoded[t] for t in texts], eos_id,
             max_total_len=max_decode_len + 1, seed=seed)
@@ -349,7 +358,8 @@ def evaluate(args: argparse.Namespace) -> dict:
                             bos_id, eos_id, args.max_decode_len,
                             use_kv_cache=not args.no_kv_cache,
                             temperature=args.temperature,
-                            top_k=args.decode_top_k, seed=args.random_seed)
+                            top_k=args.decode_top_k,
+                            top_p=args.decode_top_p, seed=args.random_seed)
     with open(report_path, "a") as f:
         f.write("\n\nInput texts -> Decoded texts\n")
         for prompt, completion in decoded:

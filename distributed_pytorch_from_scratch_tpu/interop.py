@@ -210,6 +210,28 @@ def export_state_dicts(params: Dict, cfg: ModelConfig,
                              f"reference's partitioning")
     np_ = lambda a: np.asarray(a, np.float32)
 
+    # Validate the tree against the declared shape BEFORE slicing: export
+    # trims vocab padding and loops `range(L)`, so understated flags would
+    # otherwise silently truncate the model (the import direction already
+    # fails loudly on this mistake).
+    emb_rows = np.shape(params["embedding"]["weight"])[0]
+    got_L = np.shape(params["layers"]["wq"]["weight"])[0]
+    got_d = np.shape(params["norm"]["scale"])[0]
+    got_f = np.shape(params["layers"]["down_proj"]["weight"])[1]
+    if got_L != L or got_d != d or got_f != cfg.ffn_dim:
+        raise ValueError(
+            f"checkpoint shape (layers={got_L}, attn_dim={got_d}, "
+            f"ffn_dim={got_f}) does not match the declared flags "
+            f"(layers={L}, attn_dim={d}, ffn_dim={cfg.ffn_dim})")
+    if not V <= emb_rows < V + 64:
+        # padding is < the training tp degree (<= 64 in practice); a larger
+        # gap means --vocab_size understates the trained vocab
+        raise ValueError(
+            f"checkpoint embedding has {emb_rows} vocab rows but "
+            f"--vocab_size is {V}; exporting would silently drop "
+            f"{emb_rows - V} real rows — do the flags match the trained "
+            f"model?")
+
     def col_shards(w, b, r, unpad_to=None):
         # ours (idim, odim[+pad]) -> torch (odim, idim) shard r over dim 0;
         # `unpad_to` drops trailing padded output rows (lm_head only —
@@ -339,12 +361,16 @@ def main(argv=None) -> Dict:
         params = jax.tree.map(np.asarray, params)
         # carry the real loss metadata from our filename into the exported
         # names (the reference's convention encodes it there)
+        import math
+
         src = find_rank_shards(args.our_ckpt_dir, step)
         m = CKPT_RE.search(os.path.basename(src[min(src)]))
         try:
             loss = float(m.group(3)) if m else 0.0
         except ValueError:
-            loss = 0.0  # e.g. 'nan' from an imported checkpoint
+            loss = 0.0
+        if math.isnan(loss):  # e.g. an imported checkpoint's 'loss-nan'
+            loss = 0.0
         paths = export_reference_checkpoint(params, cfg, args.export_tp,
                                             args.out_dir, step, loss=loss)
         print(f"exported iter {step} -> {len(paths)} reference rank "

@@ -202,7 +202,8 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
 
 
 def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
-                  temperature: float = 0.0, top_k: int = 0):
+                  temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 0.0):
     """Whole-generation XLA program: jitted
     (params, buf(b, buf_len), prompt_len, eos_id, max_total_len, key)
       -> (buf with generated tokens written, per-row total length (b,)).
@@ -218,13 +219,15 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
 
     `temperature` 0 = greedy argmax (the reference's only decoding rule,
     `test.py:149`); > 0 samples from softmax(logits / temperature), with
-    `top_k > 0` restricting to the k most likely tokens first — the
-    standard sampling surface the reference lacks. Sampling keys fold in
-    the cursor, so every position draws fresh randomness while staying a
-    pure function of the caller's `key`. Rows that emit EOS stop
+    `top_k > 0` restricting to the k most likely tokens first and/or
+    `top_p in (0, 1]` to the smallest nucleus whose probability mass
+    reaches p (both filters compose: top-k prunes first, then top-p) —
+    the standard sampling surface the reference lacks. Sampling keys fold
+    in the cursor, so every position draws fresh randomness while staying
+    a pure function of the caller's `key`. Rows that emit EOS stop
     contributing to their length and are padded with eos_id while other
     rows finish. One compile serves every prompt (prompt_len/eos/limit are
-    traced; temperature/top_k are build-time constants)."""
+    traced; temperature/top_k/top_p are build-time constants)."""
     cfg = model.cfg
     dtype = resolve_dtype(cfg.compute_dtype)
     # RoPE tables cover the whole decode buffer even past the model's
@@ -236,6 +239,8 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if top_k < 0 or top_k > cfg.vocab_size:
         raise ValueError(f"top_k must be in [0, vocab_size], got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1] (0 = off), got {top_p}")
 
     def shard_fn(params, buf, prompt_len, eos_id, max_total_len, key):
         b, _ = buf.shape
@@ -261,6 +266,20 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
                     # this runs once per generated token in the fused loop
                     kth = lax.top_k(scaled, top_k)[0][:, -1][:, None]
                     scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+                if top_p and top_p < 1.0:
+                    # nucleus: keep the smallest descending-prob prefix
+                    # whose mass reaches top_p (the top token always
+                    # survives: its own exclusive-cumsum is 0 < top_p)
+                    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+                    probs = jax.nn.softmax(sorted_l, axis=-1)
+                    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+                    keep = cum < top_p                        # (b, V) sorted
+                    # threshold = smallest kept logit, mapped back to the
+                    # unsorted layout by value comparison
+                    thresh = jnp.min(
+                        jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                        keepdims=True)
+                    scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
                 idx = jax.random.categorical(
                     jax.random.fold_in(key, cur), scaled, axis=-1
                 ).astype(jnp.int32)
@@ -321,7 +340,8 @@ class GreedyDecoder:
     decode_batch for reproducible draws."""
 
     def __init__(self, model: Transformer, mesh: Mesh, buf_len: int,
-                 temperature: float = 0.0, top_k: int = 0):
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0):
         if model.cp_size != 1:
             raise ValueError("decode is TP-only; build the decoder with a "
                              "cp_size=1 model (same params load fine)")
@@ -335,7 +355,8 @@ class GreedyDecoder:
         self.mesh = mesh
         self.buf_len = buf_len
         self.generate = make_generate(model, mesh, buf_len,
-                                      temperature=temperature, top_k=top_k)
+                                      temperature=temperature, top_k=top_k,
+                                      top_p=top_p)
 
     def decode(self, params, prompt_ids, eos_id: int,
                max_total_len: int, seed: int = 0) -> list:

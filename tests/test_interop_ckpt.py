@@ -307,3 +307,23 @@ def test_cli_export_roundtrip(tmp_path):
     for a, b in zip(jax.tree.leaves(back), jax.tree.leaves(params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=0, atol=0)
+
+
+def test_export_rejects_understated_flags():
+    """Shape flags that understate the trained model must fail loudly —
+    export slices vocab padding and loops range(num_layers), so a silent
+    pass would truncate the model."""
+    import dataclasses
+
+    from distributed_pytorch_from_scratch_tpu.interop import (
+        export_state_dicts)
+
+    rng = np.random.default_rng(8)
+    full = make_full_tensors(CFG, rng)
+    params = convert_state_dicts(shard_reference(full, CFG, 1), CFG)
+    small_vocab = dataclasses.replace(CFG, vocab_size=32)
+    with pytest.raises(ValueError, match="drop"):
+        export_state_dicts(params, small_vocab, 1)
+    few_layers = dataclasses.replace(CFG, num_layers=1)
+    with pytest.raises(ValueError, match="does not match"):
+        export_state_dicts(params, few_layers, 1)

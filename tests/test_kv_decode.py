@@ -184,3 +184,51 @@ def test_sampling_validation():
         make_generate(model, mesh, BUF, temperature=-1.0)
     with pytest.raises(ValueError, match="top_k"):
         make_generate(model, mesh, BUF, top_k=CFG.vocab_size + 1)
+
+
+def test_top_p_tiny_nucleus_matches_greedy():
+    """top_p -> 0+ keeps only the argmax token in the nucleus, so sampling
+    at any temperature reduces to the greedy decode; top_p=1.0 is a no-op
+    filter (same draw as the unfiltered sampler at the same seed)."""
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = Transformer(CFG, tp_size=2)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    prompt = [0, 5, 17, 33]
+
+    greedy = GreedyDecoder(model, mesh, BUF)
+    tiny = GreedyDecoder(model, mesh, BUF, temperature=1.0, top_p=1e-6)
+    g = greedy.decode_batch(params, [prompt], eos_id=EOS, max_total_len=16)[0]
+    t = tiny.decode_batch(params, [prompt], eos_id=EOS, max_total_len=16)[0]
+    assert g == t, (g, t)
+
+    full = GreedyDecoder(model, mesh, BUF, temperature=1.0)
+    noop = GreedyDecoder(model, mesh, BUF, temperature=1.0, top_p=1.0)
+    a = full.decode_batch(params, [prompt], eos_id=EOS, max_total_len=16,
+                          seed=3)[0]
+    b = noop.decode_batch(params, [prompt], eos_id=EOS, max_total_len=16,
+                          seed=3)[0]
+    assert a == b, (a, b)
+
+
+def test_top_p_deterministic_and_in_vocab():
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = Transformer(CFG, tp_size=2)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    dec = GreedyDecoder(model, mesh, BUF, temperature=1.0, top_p=0.9,
+                        top_k=16)  # composed filters
+    prompt = [0, 5, 17]
+    a = dec.decode_batch(params, [prompt], eos_id=EOS, max_total_len=BUF,
+                         seed=5)[0]
+    b = dec.decode_batch(params, [prompt], eos_id=EOS, max_total_len=BUF,
+                         seed=5)[0]
+    assert a == b
+    assert all(0 <= t < CFG.vocab_size for t in a)
+
+
+def test_top_p_validation():
+    mesh = make_mesh(MeshConfig(dp=1, tp=1))
+    model = Transformer(CFG)
+    with pytest.raises(ValueError, match="top_p"):
+        make_generate(model, mesh, BUF, top_p=1.5)
