@@ -128,6 +128,9 @@ def get_eval_args(argv=None) -> argparse.Namespace:
     if (args.decode_top_k or args.decode_top_p) and not args.temperature:
         p.error("--decode_top_k/--decode_top_p only shape SAMPLED decoding; "
                 "set --temperature > 0 (greedy ignores them)")
+    if not 0.0 <= args.decode_top_p <= 1.0:
+        p.error(f"--decode_top_p must be in [0, 1], got "
+                f"{args.decode_top_p}")
     return args
 
 

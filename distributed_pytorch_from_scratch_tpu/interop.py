@@ -223,7 +223,11 @@ def export_state_dicts(params: Dict, cfg: ModelConfig,
             f"checkpoint shape (layers={got_L}, attn_dim={got_d}, "
             f"ffn_dim={got_f}) does not match the declared flags "
             f"(layers={L}, attn_dim={d}, ffn_dim={cfg.ffn_dim})")
-    if not V <= emb_rows < V + 64:
+    if emb_rows < V:
+        raise ValueError(
+            f"checkpoint embedding has only {emb_rows} vocab rows but "
+            f"--vocab_size is {V} — the flag overstates the trained vocab")
+    if emb_rows >= V + 64:
         # padding is < the training tp degree (<= 64 in practice); a larger
         # gap means --vocab_size understates the trained vocab
         raise ValueError(

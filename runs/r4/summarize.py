@@ -1,0 +1,94 @@
+"""Collect the round-4 hardware session's artifacts into runs/r4/RESULTS.md.
+
+Pure host-side log parsing — safe to run any time (missing artifacts are
+reported as pending, not errors). run_experiment.sh appends the result to
+BASELINE.md once, after the session completes.
+"""
+
+import glob
+import json
+import os
+import re
+
+R = os.path.dirname(os.path.abspath(__file__))
+
+
+def bench_lines():
+    rows = []
+    for p in sorted(glob.glob(os.path.join(R, "bench_*.json"))):
+        tag = os.path.basename(p)[len("bench_"):-len(".json")]
+        try:
+            rec = json.loads(open(p).read().strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001
+            rows.append(f"| {tag} | unparseable ({e}) | — | — |")
+            continue
+        if "error" in rec:
+            rows.append(f"| {tag} | {rec['error']} | — | — |")
+        else:
+            mfu = rec.get("vs_baseline", 0) * 0.30 * 100
+            rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
+                        f"| {mfu:.1f}% | {rec.get('metric')} |")
+    return rows
+
+
+def train_summary(log_name):
+    path = os.path.join(R, log_name)
+    if not os.path.exists(path):
+        return None
+    text = open(path, errors="replace").read()
+    steps = re.findall(r"step (\d+)/(\d+) -> avg loss ([0-9.]+).*?"
+                       r"([0-9.]+)k tok/s(?: \((\d+)% useful\))?, "
+                       r"MFU ([0-9.]+)%", text)
+    done = "training finished" in text
+    if not steps:
+        return f"{log_name}: no step lines yet (done={done})"
+    first, last = steps[0], steps[-1]
+    return (f"{log_name}: {'finished' if done else 'IN PROGRESS'} — "
+            f"step {last[0]}/{last[1]}, loss {first[2]} -> {last[2]}, "
+            f"{last[3]}k tok/s"
+            + (f" ({last[4]}% useful)" if last[4] else "")
+            + f", MFU {last[5]}%")
+
+
+def eval_summary():
+    path = os.path.join(R, "eval.log")
+    if not os.path.exists(path):
+        return [], []
+    text = open(path, errors="replace").read()
+    vals = re.findall(r"iter (\d+): val loss ([0-9.]+)", text)
+    decodes = re.findall(r"^(.*?) -> (.*)$", text, re.M)
+    return vals, decodes[:8]
+
+
+def main():
+    out = []
+    out.append("Collected from `runs/r4/` by `summarize.py` after the "
+               "on-hardware session.")
+    out.append("")
+    rows = bench_lines()
+    if rows:
+        out.append("| bench line | result | MFU | metric |")
+        out.append("|---|---|---|---|")
+        out.extend(rows)
+    else:
+        out.append("Bench lines: none produced yet.")
+    out.append("")
+    for log in ("train.log", "train_packed.log"):
+        s = train_summary(log)
+        out.append(s if s else f"{log}: not started.")
+    vals, decodes = eval_summary()
+    if vals:
+        out.append("")
+        out.append("Validation loss per checkpoint: "
+                   + ", ".join(f"iter {i}: {v}" for i, v in vals))
+    if decodes:
+        out.append("")
+        out.append("Decoded prompts (first 8):")
+        out.extend(f"- `{p.strip()}` -> `{d.strip()}`" for p, d in decodes)
+    with open(os.path.join(R, "RESULTS.md"), "w") as f:
+        f.write("\n".join(out) + "\n")
+    print(f"wrote {os.path.join(R, 'RESULTS.md')}")
+
+
+if __name__ == "__main__":
+    main()
