@@ -28,8 +28,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .config import (BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MODEL_PRESETS,
-                     MeshConfig, ModelConfig, model_preset)
+from .cli import add_model_shape_args, build_model_config
+from .config import BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MeshConfig
 from .data.dataset import get_dataloader
 from .models.decode import GreedyDecoder
 from .models.transformer import Transformer
@@ -76,23 +76,7 @@ def get_eval_args(argv=None) -> argparse.Namespace:
                         "via the KV-cache decoder (gpt2's buffer is capped "
                         "at its learned position table)")
     g.add_argument("--ckpt_dir", required=True)
-    g.add_argument("--model", choices=sorted(MODEL_PRESETS), default=None,
-                   help="named shape preset; must match the trained model "
-                        "(explicit dim flags override preset fields)")
-    g.add_argument("--attn_dim", type=int, default=None)
-    g.add_argument("--ffn_dim", type=int, default=None)
-    g.add_argument("--num_heads", type=int, default=None)
-    g.add_argument("--num_kv_heads", type=int, default=None,
-                   help="must match the trained model (GQA, llama family)")
-    g.add_argument("--num_layers", type=int, default=None)
-    g.add_argument("--maxlen", type=int, default=None)
-    g.add_argument("--num_experts", type=int, default=None,
-                   help="MoE checkpoint shape (must match training); eval "
-                        "runs the experts unsharded (ep=1)")
-    g.add_argument("--moe_top_k", type=int, default=None)
-    g.add_argument("--moe_capacity_factor", type=float, default=None)
-    g.add_argument("--bf16", action="store_true", default=True)
-    g.add_argument("--no-bf16", dest="bf16", action="store_false")
+    add_model_shape_args(g)
 
     g = p.add_argument_group("decode")
     g.add_argument("--max_decode_len", type=int, default=128)
@@ -284,9 +268,11 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
 def evaluate(args: argparse.Namespace) -> dict:
     from tokenizers import Tokenizer as HFTokenizer
 
+    # maxlen is needed before the config (dataloader truncation + cp
+    # divisibility); build_model_config re-derives the same value
+    from .config import ModelConfig, model_preset
     preset = model_preset(args.model) if args.model else ModelConfig()
-    pick = lambda flag, dflt: dflt if flag is None else flag
-    maxlen = pick(args.maxlen, preset.maxlen)
+    maxlen = preset.maxlen if args.maxlen is None else args.maxlen
 
     if args.batch_size % args.dp_size != 0:
         raise SystemExit(f"--batch_size {args.batch_size} must be divisible "
@@ -300,18 +286,7 @@ def evaluate(args: argparse.Namespace) -> dict:
                                 split="validation", maxlen=maxlen,
                                 shuffle=False, drop_last=False)
     vocab_size = dataloader.dataset.vocab_size
-    cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
-                      ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
-                      num_heads=pick(args.num_heads, preset.num_heads),
-                      num_kv_heads=pick(args.num_kv_heads,
-                                        preset.num_kv_heads),
-                      num_layers=pick(args.num_layers, preset.num_layers),
-                      num_experts=pick(args.num_experts, preset.num_experts),
-                      moe_top_k=pick(args.moe_top_k, preset.moe_top_k),
-                      moe_capacity_factor=pick(args.moe_capacity_factor,
-                                               preset.moe_capacity_factor),
-                      vocab_size=vocab_size, maxlen=maxlen,
-                      compute_dtype="bfloat16" if args.bf16 else "float32")
+    cfg = build_model_config(args, vocab_size)
     # val loss runs the full dp x cp x tp mesh (pp/ep stay 1 at eval);
     # decoding runs the cp=1 path on the same params (models/decode.py),
     # with its batch replicated over dp/cp.

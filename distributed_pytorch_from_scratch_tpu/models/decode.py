@@ -208,8 +208,10 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
     (params, buf(b, buf_len), prompt_len, eos_id, max_total_len, key)
       -> (buf with generated tokens written, per-row total length (b,)).
 
-    `prompt_len` may be a scalar (all rows share a length) or a (b,) vector
-    — mixed-length prompt batches decode in ONE dispatch. The loop cursor is
+    `prompt_len` and `max_total_len` may each be a scalar (shared) or a
+    (b,) vector — mixed-length prompt batches decode in ONE dispatch, and
+    each row stops at ITS total-length limit (pass
+    `prompt_len + max_new` for per-prompt new-token budgets). The loop cursor is
     shared across rows ("teacher-forced catch-up"): it starts at
     min(prompt_len), and a row whose prompt extends past the cursor re-feeds
     its own prompt token (recomputing the K/V the prefill already wrote —
@@ -285,16 +287,24 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
                 ).astype(jnp.int32)
             return lax.pmax(idx, "tp")
 
-        limit = jnp.minimum(max_total_len, buf_len)
+        # per-ROW total-length cap: max_total_len may be a scalar (shared)
+        # or a (b,) vector — a row finishes once prompt_len + generated
+        # reaches ITS limit, so short prompts in a mixed batch don't keep
+        # generating until the longest row's limit (the global cursor only
+        # bounds the loop)
+        row_limit = jnp.minimum(
+            jnp.broadcast_to(jnp.asarray(max_total_len, jnp.int32), (b,)),
+            buf_len)
         cur0 = jnp.min(prompt_len)
         nxt = next_token(logits, cur0)               # (b,) per-row first token
-        done0 = (prompt_len == cur0) & (nxt == eos_id)
+        done0 = ((prompt_len == cur0) & (nxt == eos_id)) | (
+            prompt_len >= row_limit)
         gen0 = jnp.zeros((b,), jnp.int32)
         carry0 = (buf, ks, vs, nxt, done0, gen0, cur0)
 
         def cond(c):
             _, _, _, _, done, _, cur = c
-            return jnp.logical_and(cur < limit, ~jnp.all(done))
+            return jnp.logical_and(cur < jnp.max(row_limit), ~jnp.all(done))
 
         def body(c):
             buf, ck, cv, nxt, done, gen, cur = c
@@ -311,6 +321,7 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
             # token for a row only once the cursor has cleared its prompt
             starts_gen = (cur + 1) >= prompt_len
             done = done | (starts_gen & (cand == eos_id))
+            done = done | (prompt_len + gen >= row_limit)
             return (buf, ck, cv, cand, done, gen, cur + 1)
 
         buf, _, _, _, _, gen, _ = lax.while_loop(cond, body, carry0)

@@ -56,7 +56,10 @@ def eval_summary():
         return [], []
     text = open(path, errors="replace").read()
     vals = re.findall(r"iter (\d+): val loss ([0-9.]+)", text)
-    decodes = re.findall(r"^(.*?) -> (.*)$", text, re.M)
+    # decode lines only — warnings ('clamping decode buffer 128 -> 64')
+    # also contain ' -> ' and must not displace real decodes
+    decodes = [(a, b) for a, b in re.findall(r"^(.*?) -> (.*)$", text, re.M)
+               if not a.startswith("Warning") and "clamping" not in a]
     return vals, decodes[:8]
 
 

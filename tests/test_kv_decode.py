@@ -232,3 +232,27 @@ def test_top_p_validation():
     model = Transformer(CFG)
     with pytest.raises(ValueError, match="top_p"):
         make_generate(model, mesh, BUF, top_p=1.5)
+
+
+def test_per_row_total_length_limits():
+    """max_total_len as a (b,) vector: each row stops at ITS limit — a
+    short prompt in a mixed batch must not generate until the longest
+    row's limit (the generate CLI's per-prompt --max_new_tokens)."""
+    import numpy as np
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = Transformer(CFG, tp_size=2)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    dec = GreedyDecoder(model, mesh, BUF)
+    short, long = [0, 5], [0, 5, 17, 33, 2, 9, 11, 21]
+    # per-row budget: 4 new tokens each
+    limits = np.asarray([len(short) + 4, len(long) + 4], np.int32)
+    gens = dec.decode_batch(params, [short, long], eos_id=-1,
+                            max_total_len=limits)
+    assert len(gens[0]) == 4, gens[0]
+    assert len(gens[1]) == 4, gens[1]
+    # and each row's tokens equal its solo decode (limits don't couple rows)
+    solo = dec.decode_batch(params, [short], eos_id=-1,
+                            max_total_len=len(short) + 4)[0]
+    assert gens[0] == solo, (gens[0], solo)
