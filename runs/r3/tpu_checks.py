@@ -4,7 +4,13 @@ fused and split block paths, the positional block kernel (ring attention's
 building block) fwd+bwd, and the cp=1 ring path compiled through shard_map.
 Prints PASS lines; exits nonzero on any mismatch."""
 
+import os
 import sys
+
+# Runnable from anywhere: `python runs/r3/tpu_checks.py` puts runs/r3 (not the
+# repo root) on sys.path, so the package import below needs the root added.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,15 @@
+"""Replace the auto-collected hardware-results section of BASELINE.md with the
+current runs/r4/RESULTS.md (same logic as the inline step in
+runs/r4/run_experiment.sh, factored out so follow-up passes can refresh too)."""
+
+import re
+
+base = open("/root/repo/BASELINE.md").read()
+res = open("/root/repo/runs/r4/RESULTS.md").read()
+base = re.sub(
+    r"\n## Round-4 hardware results \(auto-collected\)\n[\s\S]*?(?=\n## |\Z)",
+    "", base)
+with open("/root/repo/BASELINE.md", "w") as f:
+    f.write(base.rstrip("\n") + "\n\n"
+            "## Round-4 hardware results (auto-collected)\n\n" + res)
+print("BASELINE.md hardware-results section refreshed")
