@@ -23,6 +23,7 @@ Extra diagnostics (tp all-reduce p50 latency, MFU, memory) go to stderr.
 """
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -129,6 +130,11 @@ def main(argv=None):
 
     B = args.batch or (8 if args.model == "gpt2-124m" else 32)
     T = args.seqlen or cfg.maxlen
+    if T > cfg.maxlen:
+        # long-context bench lines (e.g. --seqlen 8192 on the 45m preset):
+        # the RoPE/position tables must cover T or every position past
+        # maxlen clips to the last row (ops/rope.py clip-mode indexing)
+        cfg = dataclasses.replace(cfg, maxlen=T)
     key = jax.random.key(1)
     ids = jax.random.randint(key, (B, T), 0, cfg.vocab_size)
     tgt = jnp.roll(ids, -1, axis=1)

@@ -29,13 +29,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import multihost_utils
 
 from .config import (IGNORE_INDEX, MODEL_PRESETS, REMAT_CHOICES, MeshConfig,
                      ModelConfig, OptimizerConfig, TrainConfig, model_preset)
 from .data.dataset import get_dataloader
 from .data.prefetch import Prefetcher, stack_window, window_stream
 from .models.transformer import Transformer
-from .runtime.mesh import make_mesh
+from .runtime.mesh import init_multihost, make_mesh
 from .training.checkpoint import (latest_step, load_checkpoint,
                                   save_checkpoint)
 from .training.metrics import (MetricsWriter, ProfilerTrace,
@@ -192,6 +193,16 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="jax.config.debug_nans: fail fast on the first "
                         "non-finite value (the functional analogue of a "
                         "sanitizer — SURVEY §5.2)")
+    g.add_argument("--coordinator", type=str, default=None,
+                   help="multi-host DCN rendezvous address host:port "
+                        "(or set COORDINATOR_ADDRESS); omit on a single "
+                        "host — the reference's --master_addr/--master_port "
+                        "equivalent, /root/reference/train.py:30-31")
+    g.add_argument("--num_processes", type=int, default=None,
+                   help="multi-host: total process count (TPU pods "
+                        "autodetect this; needed for CPU multi-process runs)")
+    g.add_argument("--process_id", type=int, default=None,
+                   help="multi-host: this process's id (see --num_processes)")
     return p.parse_args(argv)
 
 
@@ -231,6 +242,13 @@ class _ShutdownFlag:
 def train(args: argparse.Namespace) -> dict:
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
+    # Multi-host rendezvous before any backend use (no-op on single host;
+    # tests/test_multihost.py drives the underlying init across processes).
+    init_multihost(getattr(args, "coordinator", None),
+                   num_processes=args.num_processes,
+                   process_id=args.process_id)
+    nproc = jax.process_count()
+    is_main = jax.process_index() == 0
     mesh_cfg = MeshConfig(dp=args.dp_size, tp=args.tp_size, cp=args.cp_size,
                           ep=args.ep_size, pp=args.pp_size)
     if mesh_cfg.world_size > jax.device_count():
@@ -316,17 +334,44 @@ def train(args: argparse.Namespace) -> dict:
     opt_state = init_adam_state(params)
     start_step = 0
     if args.resume:
-        last = latest_step(args.save_dir)
-        if last is not None:
-            params, opt_state, start_step = load_checkpoint(
-                args.save_dir, last, model.to_canonical(params),
-                model.canonical_specs(), with_opt=True)
-            params = model.from_canonical(params)
-            if opt_state is None:
-                opt_state = init_adam_state(params)
-            else:
-                opt_state = _map_moments(opt_state, model.from_canonical)
-            print(f"resumed from iter {start_step} in {args.save_dir}")
+        if nproc > 1:
+            # Only process 0's host is assumed to hold the checkpoint files
+            # (it is the only writer — see schedule_save). It loads and
+            # broadcasts host trees; every process supplies its freshly
+            # initialised tree as the shape/dtype template.
+            last = latest_step(args.save_dir) if is_main else None
+            last = int(multihost_utils.broadcast_one_to_all(
+                np.int64(-1 if last is None else last)))
+            if last >= 0:
+                tmpl_p = model.to_canonical(params)
+                tmpl_o = _map_moments(opt_state, model.to_canonical)
+                if is_main:
+                    ck_p, ck_o, start_step = load_checkpoint(
+                        args.save_dir, last, tmpl_p,
+                        model.canonical_specs(), with_opt=True)
+                    if ck_o is None:
+                        ck_o = tmpl_o
+                else:
+                    ck_p, ck_o, start_step = tmpl_p, tmpl_o, 0
+                ck_p, ck_o = multihost_utils.broadcast_one_to_all((ck_p, ck_o))
+                start_step = int(multihost_utils.broadcast_one_to_all(
+                    np.int64(start_step)))
+                params = model.from_canonical(ck_p)
+                opt_state = _map_moments(ck_o, model.from_canonical)
+                print(f"resumed from iter {start_step} in {args.save_dir} "
+                      f"(broadcast from process 0)")
+        else:
+            last = latest_step(args.save_dir)
+            if last is not None:
+                params, opt_state, start_step = load_checkpoint(
+                    args.save_dir, last, model.to_canonical(params),
+                    model.canonical_specs(), with_opt=True)
+                params = model.from_canonical(params)
+                if opt_state is None:
+                    opt_state = init_adam_state(params)
+                else:
+                    opt_state = _map_moments(opt_state, model.from_canonical)
+                print(f"resumed from iter {start_step} in {args.save_dir}")
 
     shardings = model.shardings(mesh)
     params = jax.device_put(params, shardings)
@@ -358,10 +403,29 @@ def train(args: argparse.Namespace) -> dict:
     else:
         step_fn = build_train_step(model, mesh, ocfg, args.loss_mode,
                                    **builder_kwargs)
-    writer = MetricsWriter(os.path.join(args.save_dir, "logs"))
+    # One metrics/trace dir per process in multi-host runs (the reference
+    # keeps one TB dir per rank, `/root/reference/train.py:85`); TB event
+    # files and profiler traces from two writers in one dir clobber.
+    logs_dir = os.path.join(args.save_dir, "logs") if nproc == 1 else \
+        os.path.join(args.save_dir, "logs", f"proc{jax.process_index()}")
+    writer = MetricsWriter(logs_dir)
+
+    if nproc > 1:
+        # Multi-host batch feeding: a host-local full batch cannot be passed
+        # to a jit whose shardings span non-addressable devices. Every
+        # process iterates the identical (same-seed) dataloader and
+        # contributes the shards it owns of the SAME global batch — the
+        # assembled array is bitwise what the single-process run feeds.
+        def feed(x):
+            spec = jax.sharding.PartitionSpec(
+                *([None] * (x.ndim - 2)), ("dp", "ep"), "cp")
+            return jax.make_array_from_callback(
+                x.shape, jax.sharding.NamedSharding(mesh, spec),
+                lambda idx: x[idx])
+    else:
+        feed = jnp.asarray
     # profile a window shortly after start so compile+layout churn is over
-    profiler = ProfilerTrace(os.path.join(args.save_dir, "logs"),
-                             start_step=start_step + 3,
+    profiler = ProfilerTrace(logs_dir, start_step=start_step + 3,
                              num_steps=args.profile_steps)
     flops_step = model_flops_per_step(
         cfg, args.batch_size, maxlen,
@@ -395,8 +459,32 @@ def train(args: argparse.Namespace) -> dict:
     useful_since = 0  # non-IGNORE_INDEX targets: real tokens vs padding
     done = False
     shutdown = _ShutdownFlag()
+
+    _last_poll = [None]
+
+    def shutdown_agreed(step=None) -> bool:
+        """Cross-host-consistent shutdown decision. schedule_save runs a
+        collective in multi-host mode, so acting on a process-local signal
+        would send one process into an all-gather the others never enter
+        (deadlock). Process 0's flag is broadcast and every process acts on
+        THAT; a signal delivered only to a non-zero process is ignored
+        (schedulers deliver preemption to every host — and the single-host
+        case never takes this path). The broadcast blocks on device_get, so
+        inside the loop (`step` given) it runs only once per log_interval
+        steps: preemption reaction lags up to that many steps, and host
+        dispatch stays async in between."""
+        if nproc == 1:
+            return shutdown.requested
+        if step is not None:
+            if (_last_poll[0] is not None
+                    and step - _last_poll[0] < args.log_interval):
+                return False
+            _last_poll[0] = step
+        return bool(multihost_utils.broadcast_one_to_all(
+            np.int32(shutdown.requested if is_main else 0)))
     last_saved = start_step
     pending_save = None  # at most one async checkpoint write in flight
+    replicate_fn = []  # lazily-built jitted all-gather for multi-host saves
 
     def join_save():
         nonlocal pending_save
@@ -410,9 +498,45 @@ def train(args: argparse.Namespace) -> dict:
         nonlocal pending_save, last_saved
         avg = float(accum_loss) / (step - start_step)
         join_save()  # bound in-flight async writes to one
+        save_params = model.to_canonical(params)
         save_opt = _map_moments(opt_state, model.to_canonical)
+        if nproc > 1:
+            # Cross-host shards are not addressable from this process, so
+            # `jax.device_get` inside the writer would fail. All-gather to
+            # every host (XLA collective — all processes must participate),
+            # then only process 0 touches the filesystem. Params and the two
+            # Adam moments gather SEQUENTIALLY and land in host RAM one at a
+            # time, so peak extra device memory is one param-tree — still
+            # O(full model) per device transiently, which under --zero1
+            # means saves need that much headroom (per-host shard files
+            # would remove even that; not needed at this framework's
+            # scales).
+            if not replicate_fn:
+                replicate_fn.append(jax.jit(
+                    lambda t: t, out_shardings=jax.tree.map(
+                        lambda _: jax.sharding.NamedSharding(
+                            mesh, jax.sharding.PartitionSpec()),
+                        save_params)))
+
+            def gather_host(tree):
+                rep = replicate_fn[0](tree)
+                if is_main:
+                    return jax.device_get(rep)
+                jax.block_until_ready(rep)  # serialize; buffers free on drop
+                return None
+
+            host_p = gather_host(save_params)
+            host_mu = gather_host(save_opt.mu)
+            host_nu = gather_host(save_opt.nu)
+            if not is_main:
+                last_saved = step
+                return
+            save_params = host_p
+            save_opt = save_opt.__class__(
+                step=np.asarray(int(jax.device_get(save_opt.step)), np.int32),
+                mu=host_mu, nu=host_nu)
         pending_save = save_checkpoint(
-            args.save_dir, step, avg, model.to_canonical(params),
+            args.save_dir, step, avg, save_params,
             model.canonical_specs(), args.tp_size, save_opt,
             reserve_last_n=args.reserve_last_n_ckpts,
             async_write=True)
@@ -457,7 +581,7 @@ def train(args: argparse.Namespace) -> dict:
                 # resume re-reads them. Dispatch is async, so a signal
                 # arriving mid-execution is caught here before the next
                 # dispatch launches.
-                if shutdown.requested:
+                if shutdown_agreed(n):
                     prefetcher.close()
                     shutdown_save(n)
                     done = True
@@ -481,18 +605,18 @@ def train(args: argparse.Namespace) -> dict:
                         else accum
                     params, opt_state, losses = step_fn(
                         params, opt_state,
-                        jnp.asarray(window["input_ids"]),
-                        jnp.asarray(window["target_ids"]),
-                        jnp.asarray(window["position_ids"]))
+                        feed(window["input_ids"]),
+                        feed(window["target_ids"]),
+                        feed(window["position_ids"]))
                     # accumulation: `losses` is already the one step's mean
                     loss = losses if accum > 1 else jnp.sum(losses)
                 else:
                     steps_in = 1
                     params, opt_state, loss = step_fn(
                         params, opt_state,
-                        jnp.asarray(window["input_ids"]),
-                        jnp.asarray(window["target_ids"]),
-                        jnp.asarray(window["position_ids"]))
+                        feed(window["input_ids"]),
+                        feed(window["target_ids"]),
+                        feed(window["position_ids"]))
                 n += 1 if accum > 1 else steps_in
                 tokens_since += window["input_ids"].size
                 useful_since += int((window["target_ids"]
@@ -540,7 +664,7 @@ def train(args: argparse.Namespace) -> dict:
         # code polled after every step and caught this window). The
         # n > last_saved guard keeps a signal the poll already handled from
         # printing the shutdown message twice.
-        if shutdown.requested and n > last_saved:
+        if n > last_saved and shutdown_agreed():
             shutdown_save(n)
     finally:
         # On ANY exit (including a raising step): stop the prefetch thread

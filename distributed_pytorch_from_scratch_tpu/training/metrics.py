@@ -171,8 +171,13 @@ def device_memory_gib(device: Optional[jax.Device] = None) -> float:
     """Bytes in use on the device, in GiB (analogue of
     `torch.cuda.memory_reserved`, reference `train.py:119`)."""
     if device is None:
-        device = jax.devices()[0]
-    stats = getattr(device, "memory_stats", lambda: None)()
+        # local: in a multi-process run, jax.devices()[0] can belong to
+        # another process — MemoryStats on a non-addressable device raises
+        device = jax.local_devices()[0]
+    try:
+        stats = getattr(device, "memory_stats", lambda: None)()
+    except Exception:  # platform backends without stats raise, not None
+        return 0.0
     if not stats:
         return 0.0
     return stats.get("bytes_in_use", 0) / 1024 ** 3

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Log axon tunnel reachability every ~3 min to /tmp/tpu_status_r4.txt.
+# Pure observer: the in-flight training process retries/blocks on its own.
+set -u
+while true; do
+  ts=$(date -u +%FT%TZ)
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" \
+      >/dev/null 2>&1; then
+    echo "$ts UP" >> /tmp/tpu_status_r4.txt
+  else
+    echo "$ts down" >> /tmp/tpu_status_r4.txt
+  fi
+  sleep 180
+done

@@ -21,7 +21,7 @@ fi
 
 # ---- bench lines (BENCH_r04 evidence; driver re-runs bench.py itself)
 for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
-            "45m:--steps_per_dispatch 16" "45m:--maxlen 8192 --batch_size 2"; do
+            "45m:--steps_per_dispatch 16" "45m:--seqlen 8192 --batch 2"; do
   model="${spec%%:*}"; extra="${spec#*:}"
   tag="${model}$(echo "$extra" | tr -d ' -')"
   # a backend_unavailable error line (bench.py rc=3, e.g. tunnel dropped
@@ -33,9 +33,11 @@ for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
   if [ ! -s "$R/bench_${tag}.json" ]; then
     echo "=== bench $model $extra ===" | tee -a "$R/session.log"
     # shellcheck disable=SC2086
-    if ! timeout 1200 python bench.py --model "$model" $extra \
-        > "$R/bench_${tag}.json" 2>> "$R/session.log"; then
-      echo "bench $tag failed rc=$?" | tee -a "$R/session.log"
+    timeout 1800 python bench.py --model "$model" $extra \
+        > "$R/bench_${tag}.json" 2>> "$R/session.log"
+    rc=$?
+    if [ $rc -ne 0 ]; then
+      echo "bench $tag failed rc=$rc (124=timeout)" | tee -a "$R/session.log"
       rm -f "$R/bench_${tag}.json"
     else
       cat "$R/bench_${tag}.json" | tee -a "$R/session.log"
