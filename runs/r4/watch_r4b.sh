@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 hardware watcher, second generation. The first session run
+# completed its SHELL while the tunnel was down (training/eval failed fast
+# on backend-unavailable), so "=== done" in session.log no longer means the
+# session is complete. This watcher keys on the actual artifacts and keeps
+# relaunching the idempotent run_experiment.sh until they all exist:
+#   - tpu_checks.ok
+#   - all 6 bench_*.json lines
+#   - train.log + train_packed.log with "training finished"
+#   - eval.log with at least one "val loss" line
+# Probe log: /tmp/tpu_status_r4.txt (shared with probe_tunnel.sh).
+set -u
+R=/root/repo/runs/r4
+LOG=/tmp/tpu_status_r4.txt
+
+complete() {
+  [ -s "$R/tpu_checks.ok" ] || return 1
+  for t in 45m gpt2-124m 45m-moe8 45mremattrue 45msteps_per_dispatch16 \
+           45mseqlen8192batch2; do
+    [ -s "$R/bench_${t}.json" ] || return 1
+  done
+  grep -q "training finished" "$R/train.log" 2>/dev/null || return 1
+  grep -q "training finished" "$R/train_packed.log" 2>/dev/null || return 1
+  grep -q "val loss" "$R/eval.log" 2>/dev/null || return 1
+  return 0
+}
+
+while true; do
+  if complete; then
+    echo "$(date -u +%FT%TZ) session artifacts complete — watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" \
+      >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) UP — (re)launching run_experiment.sh" >> "$LOG"
+    bash "$R/run_experiment.sh" >> "$R/launcher.log" 2>&1
+    echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
+    sleep 120
+  else
+    sleep 180
+  fi
+done
