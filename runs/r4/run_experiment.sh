@@ -21,6 +21,7 @@ fi
 
 # ---- bench lines (BENCH_r04 evidence; driver re-runs bench.py itself)
 for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
+            "45m:--remat false" \
             "45m:--steps_per_dispatch 16" "45m:--seqlen 8192 --batch 2"; do
   model="${spec%%:*}"; extra="${spec#*:}"
   tag="${model}$(echo "$extra" | tr -d ' -')"
@@ -44,6 +45,15 @@ for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
     fi
   fi
 done
+
+# ---- kernel block-size sweep on the real chip (VERDICT r3 weak #2: the
+# 1024x1024 defaults were swept against the pre-GQA kernel)
+if [ ! -s "$R/tune_blocks.log" ] || ! grep -q "BEST" "$R/tune_blocks.log"; then
+  echo "=== flash block sweep (quick) ===" | tee -a "$R/session.log"
+  timeout 2400 python scripts/tune_flash_blocks.py --quick --iters 10 \
+      > "$R/tune_blocks.log" 2>&1 || echo "block sweep failed" | tee -a "$R/session.log"
+  grep -E "===|BEST" "$R/tune_blocks.log" | tee -a "$R/session.log"
+fi
 
 # ---- the real training run (recipe steps 5+8 analogue on hardware)
 TOKENS=/tmp/corpus_tokens.json
