@@ -24,10 +24,14 @@ def bench_lines():
             continue
         if "error" in rec:
             rows.append(f"| {tag} | {rec['error']} | — | — |")
-        else:
+        elif rec.get("unit") == "tokens/sec/chip":
             mfu = rec.get("vs_baseline", 0) * 0.30 * 100
             rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
                         f"| {mfu:.1f}% | {rec.get('metric')} |")
+        else:  # decode line: vs_baseline is a speedup, not MFU/0.30
+            rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
+                        f"| x{rec.get('vs_baseline')} vs reference decode "
+                        f"| {rec.get('metric')} |")
     return rows
 
 

@@ -63,3 +63,27 @@ def test_bench_emits_one_json_line(extra):
     assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
     assert rec["unit"] == "tokens/sec/chip"
     assert rec["value"] > 0
+
+
+def test_decode_bench_emits_one_json_line():
+    """--decode measures KV-cache generation throughput; vs_baseline is the
+    speedup over the reference-semantics full-recompute per-token loop
+    (`/root/reference/test.py:141-161`), which must come out > 1."""
+    p = subprocess.run(
+        [sys.executable, "-c", (
+            "import os;"
+            "os.environ['XLA_FLAGS']=os.environ.get('XLA_FLAGS','')"
+            " + ' --xla_force_host_platform_device_count=8';"
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import bench;"
+            "bench.main(['--model','tiny','--decode','--batch','2',"
+            "'--prompt_len','8','--gen_tokens','12','--tp','1'])")],
+        capture_output=True, text=True, timeout=500, cwd=REPO_ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["unit"] == "tokens/sec"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 1, rec  # KV cache must beat full recompute
