@@ -111,6 +111,8 @@ def run_decode_bench(args, mesh, cfg, tp: int) -> None:
                             model.shardings(mesh))
     B = args.batch or 8
     plen, gen = args.prompt_len, args.gen_tokens
+    if plen <= 0 or gen <= 0:
+        raise SystemExit("--decode needs --prompt_len and --gen_tokens >= 1")
     buf_len = plen + gen + 2
     eos = 1  # the shipped tokenizer's EOS (tokenizer/tokenizer.json)
     import numpy as np
@@ -131,7 +133,6 @@ def run_decode_bench(args, mesh, cfg, tp: int) -> None:
     # (evaluate.py --no_kv_cache). Time a slice of the budget and scale the
     # per-token cost by the produced-token count for a fair rate.
     step = make_greedy_decoder(model, mesh, buf_len)
-    import numpy as np
     buf = np.full((1, buf_len), eos, np.int32)
     buf[0, :plen] = prompts[0]
     int(step(params, jnp.asarray(buf), plen))  # compile

@@ -33,7 +33,7 @@ from .config import BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MeshConfig
 from .data.dataset import get_dataloader
 from .models.decode import GreedyDecoder
 from .models.transformer import Transformer
-from .runtime.mesh import make_mesh
+from .runtime.mesh import batch_feeder, init_multihost, make_mesh
 from .training.checkpoint import list_checkpoints, load_checkpoint
 from .training.metrics import MetricsWriter
 
@@ -97,6 +97,11 @@ def get_eval_args(argv=None) -> argparse.Namespace:
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
+    g.add_argument("--coordinator", type=str, default=None,
+                   help="multi-host DCN rendezvous host:port (same contract "
+                        "as train.py; omit on a single host)")
+    g.add_argument("--num_processes", type=int, default=None)
+    g.add_argument("--process_id", type=int, default=None)
     g.add_argument("--batch_size", type=int, default=8,
                    help="validation batch size (the reference pins 1, "
                         "test.py:105, which makes a 20-checkpoint sweep "
@@ -140,7 +145,8 @@ def _pad_batch(batch, rows: int):
     }
 
 
-def calc_val_loss(loss_fn, params, dataloader, batch_rows: int) -> float:
+def calc_val_loss(loss_fn, params, dataloader, batch_rows: int,
+                  feed=jnp.asarray, collect=np.asarray) -> float:
     """Mean of per-document CE means — the reference's bs=1 sweep semantics
     (`test.py:58-80`) at any batch size (every document's token-mean weighs
     equally, so --batch_size only changes dispatch count, not the number),
@@ -152,10 +158,10 @@ def calc_val_loss(loss_fn, params, dataloader, batch_rows: int) -> float:
     for batch in dataloader.epoch(0):
         batch = _pad_batch(batch, batch_rows)
         means, real = loss_fn(params,
-                              jnp.asarray(batch["input_ids"]),
-                              jnp.asarray(batch["target_ids"]),
-                              jnp.asarray(batch["position_ids"]))
-        means, real = np.asarray(means), np.asarray(real)
+                              feed(batch["input_ids"]),
+                              feed(batch["target_ids"]),
+                              feed(batch["position_ids"]))
+        means, real = collect(means), collect(real)
         total += float(means[real].sum())
         docs += int(real.sum())
     return total / max(docs, 1)
@@ -268,6 +274,15 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
 def evaluate(args: argparse.Namespace) -> dict:
     from tokenizers import Tokenizer as HFTokenizer
 
+    # Multi-host rendezvous before any backend use (no-op single host).
+    # Only process 0's host needs the checkpoint files and writes reports;
+    # every process runs the (collective) forward passes.
+    init_multihost(getattr(args, "coordinator", None),
+                   num_processes=args.num_processes,
+                   process_id=args.process_id)
+    nproc = jax.process_count()
+    is_main = jax.process_index() == 0
+
     # maxlen is needed before the config (dataloader truncation + cp
     # divisibility); build_model_config re-derives the same value
     from .config import ModelConfig, model_preset
@@ -304,26 +319,62 @@ def evaluate(args: argparse.Namespace) -> dict:
         model = Transformer(cfg, tp_size=args.tp_size)
     template = model.init(jax.random.key(args.random_seed))
     loss_fn = model_val.make_doc_loss(mesh)
+    feed = batch_feeder(mesh)
+    if nproc > 1:
+        # per-document means come back dp-sharded; replicate across hosts
+        # before the host fetch (tiny (b,)-vectors — negligible traffic)
+        from jax.sharding import NamedSharding, PartitionSpec
+        _rep = jax.jit(lambda t: t,
+                       out_shardings=NamedSharding(mesh, PartitionSpec()))
+        collect = lambda x: np.asarray(_rep(x))
+    else:
+        collect = np.asarray
 
-    ckpts = list_checkpoints(args.ckpt_dir, rank=0)
-    if not ckpts:
+    if nproc > 1:
+        from jax.experimental import multihost_utils
+        ckpts = list_checkpoints(args.ckpt_dir, rank=0) if is_main else []
+        # broadcast needs equal shapes on every process: count first
+        n_ck = int(multihost_utils.broadcast_one_to_all(
+            np.int64(len(ckpts) if is_main else 0)))
+        its = np.full(n_ck, -1, np.int64)
+        if is_main:
+            its[:] = [it for it, _ in ckpts]
+        its = multihost_utils.broadcast_one_to_all(its)
+        paths = {it: path for it, path in ckpts} if is_main else {}
+
+        def load_params(it):
+            t = (load_checkpoint(args.ckpt_dir, it, template,
+                                 model.specs())[0] if is_main else template)
+            return multihost_utils.broadcast_one_to_all(t)
+        ckpt_iters = [int(i) for i in its]
+    else:
+        ckpts = list_checkpoints(args.ckpt_dir, rank=0)
+        paths = {it: path for it, path in ckpts}
+
+        def load_params(it):
+            return load_checkpoint(args.ckpt_dir, it, template,
+                                   model.specs())[0]
+        ckpt_iters = [it for it, _ in ckpts]
+    if not ckpt_iters:
         raise SystemExit(f"no checkpoints found in {args.ckpt_dir}")
-    print(f"found {len(ckpts)} checkpoints")
+    if is_main:
+        print(f"found {len(ckpt_iters)} checkpoints")
 
-    writer = MetricsWriter(os.path.join(args.ckpt_dir, "val"))
+    writer = MetricsWriter(os.path.join(args.ckpt_dir, "val")) if is_main \
+        else None
     report_path = os.path.join(args.ckpt_dir, "val", "val.txt")
     results = {}
     params = None
-    with open(report_path, "a") as f:
+    with open(report_path if is_main else os.devnull, "a") as f:
         f.write("Ckpt -> Validation loss\n")
-        for it, path in ckpts:
-            params, _, _ = load_checkpoint(args.ckpt_dir, it, template,
-                                           model.specs())
-            params = jax.device_put(params, model.shardings(mesh))
-            avg = calc_val_loss(loss_fn, params, dataloader, args.batch_size)
-            print(f"iter {it}: val loss {avg:.4f}")
-            f.write(f"{path} -> {avg:.4f}\n")
-            writer.scalar("val/loss", avg, it)
+        for it in ckpt_iters:
+            params = jax.device_put(load_params(it), model.shardings(mesh))
+            avg = calc_val_loss(loss_fn, params, dataloader,
+                                args.batch_size, feed=feed, collect=collect)
+            if is_main:
+                print(f"iter {it}: val loss {avg:.4f}")
+                f.write(f"{paths.get(it, f'iter-{it}')} -> {avg:.4f}\n")
+                writer.scalar("val/loss", avg, it)
             results[it] = avg
 
     # params now holds the NEWEST checkpoint (the reference meant to do this
@@ -338,12 +389,14 @@ def evaluate(args: argparse.Namespace) -> dict:
                             temperature=args.temperature,
                             top_k=args.decode_top_k,
                             top_p=args.decode_top_p, seed=args.random_seed)
-    with open(report_path, "a") as f:
+    with open(report_path if is_main else os.devnull, "a") as f:
         f.write("\n\nInput texts -> Decoded texts\n")
         for prompt, completion in decoded:
-            print(f"{prompt} -> {completion}")
+            if is_main:
+                print(f"{prompt} -> {completion}")
             f.write(f"{prompt} -> {completion}\n")
-    writer.close()
+    if writer is not None:
+        writer.close()
     return {"val_losses": results, "decoded": decoded}
 
 

@@ -84,6 +84,29 @@ def named(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def batch_feeder(mesh: Mesh):
+    """Host-batch -> device-array function for (b, t)-shaped (or leading-
+    stacked) token batches, multi-host-aware.
+
+    Single process: `jnp.asarray` (jit reshards per the step's in-specs).
+    Multi-process: a host-local full batch cannot be passed to a jit whose
+    shardings span non-addressable devices, so the global array is
+    assembled via `jax.make_array_from_callback` — every process holds the
+    identical (same-seed) host batch and contributes the shards it owns.
+    The leading dims beyond (b, t) (steps_per_dispatch / grad-accum
+    stacking) stay unsharded, matching the jnp.asarray path."""
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return jnp.asarray
+
+    def feed(x):
+        spec = P(*([None] * (x.ndim - 2)), (DP_AXIS, EP_AXIS), CP_AXIS)
+        return jax.make_array_from_callback(
+            x.shape, NamedSharding(mesh, spec), lambda idx: x[idx])
+
+    return feed
+
+
 def init_multihost(coordinator: Optional[str] = None,
                    num_processes: Optional[int] = None,
                    process_id: Optional[int] = None) -> None:

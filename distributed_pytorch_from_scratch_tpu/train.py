@@ -36,7 +36,7 @@ from .config import (IGNORE_INDEX, MODEL_PRESETS, REMAT_CHOICES, MeshConfig,
 from .data.dataset import get_dataloader
 from .data.prefetch import Prefetcher, stack_window, window_stream
 from .models.transformer import Transformer
-from .runtime.mesh import init_multihost, make_mesh
+from .runtime.mesh import batch_feeder, init_multihost, make_mesh
 from .training.checkpoint import (latest_step, load_checkpoint,
                                   save_checkpoint)
 from .training.metrics import (MetricsWriter, ProfilerTrace,
@@ -410,20 +410,9 @@ def train(args: argparse.Namespace) -> dict:
         os.path.join(args.save_dir, "logs", f"proc{jax.process_index()}")
     writer = MetricsWriter(logs_dir)
 
-    if nproc > 1:
-        # Multi-host batch feeding: a host-local full batch cannot be passed
-        # to a jit whose shardings span non-addressable devices. Every
-        # process iterates the identical (same-seed) dataloader and
-        # contributes the shards it owns of the SAME global batch — the
-        # assembled array is bitwise what the single-process run feeds.
-        def feed(x):
-            spec = jax.sharding.PartitionSpec(
-                *([None] * (x.ndim - 2)), ("dp", "ep"), "cp")
-            return jax.make_array_from_callback(
-                x.shape, jax.sharding.NamedSharding(mesh, spec),
-                lambda idx: x[idx])
-    else:
-        feed = jnp.asarray
+    # single-process: jnp.asarray; multi-host: global-array assembly from
+    # per-process shards (every process iterates the identical dataloader)
+    feed = batch_feeder(mesh)
     # profile a window shortly after start so compile+layout churn is over
     profiler = ProfilerTrace(logs_dir, start_step=start_step + 3,
                              num_steps=args.profile_steps)

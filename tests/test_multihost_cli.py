@@ -128,3 +128,70 @@ def test_multihost_cli_resume_broadcast(tokens_json, tmp_path):
     for out in outs:
         assert "resumed from iter 3" in out, out
     assert _final_loss(outs[0]) == _final_loss(outs[1])
+
+
+def test_multihost_eval_matches_single_process(tmp_path):
+    """evaluate.py across two processes: same val-loss sweep and decodes as
+    the single-process run (checkpoints broadcast from process 0, doc-mean
+    losses replicated before the host fetch, process-0-only report)."""
+    import json as _json
+    d = tmp_path
+    texts = [f"the quick brown fox jumps over the lazy dog number {i} and "
+             f"great empire never falls it only sleeps" for i in range(40)]
+    with open(d / "texts.json", "w") as f:
+        _json.dump({"train": texts, "validation": texts[:6]}, f)
+    fix = subprocess.run(
+        [sys.executable, "-c", (
+            "import sys; sys.path.insert(0, %r)\n"
+            "from distributed_pytorch_from_scratch_tpu.data.tokenizer import "
+            "pre_tokenize, train_bpe\n"
+            "train_bpe(%r, %r, vocab_size=280)\n"
+            "pre_tokenize(%r, %r, %r)\n" % (
+                REPO, str(d / "texts.json"), str(d / "tok.json"),
+                str(d / "texts.json"), str(d / "tokens.json"),
+                str(d / "tok.json")))],
+        env=_env(8), cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert fix.returncode == 0, fix.stderr
+
+    shape = ["--attn_dim", "64", "--ffn_dim", "128", "--num_heads", "4",
+             "--num_layers", "2", "--maxlen", "32"]
+    tr = subprocess.run(
+        [sys.executable, "-m", "distributed_pytorch_from_scratch_tpu.train",
+         "--data_path", str(d / "tokens.json"), "--save_dir", str(d / "ck"),
+         *shape, "--dp_size", "2", "--tp_size", "4", "--batch_size", "8",
+         "--max_steps", "4", "--warmup_steps", "2", "--save_interval", "2"],
+        env=_env(8), cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert tr.returncode == 0, tr.stderr
+
+    eval_cmd = [sys.executable, "-m",
+                "distributed_pytorch_from_scratch_tpu.evaluate",
+                "--data_path", str(d / "tokens.json"),
+                "--ckpt_dir", str(d / "ck"),
+                "--tokenizer_path", str(d / "tok.json"), *shape,
+                "--dp_size", "2", "--tp_size", "4", "--batch_size", "4",
+                "--max_decode_len", "16"]
+    single = subprocess.run(eval_cmd, env=_env(8), cwd=REPO,
+                            capture_output=True, text=True, timeout=900)
+    assert single.returncode == 0, single.stderr
+    want = re.findall(r"iter (\d+): val loss ([0-9.]+)", single.stdout)
+    assert want, single.stdout
+
+    port = _free_port()
+    mh = ["--coordinator", f"localhost:{port}", "--num_processes", "2"]
+    procs = [subprocess.Popen(eval_cmd + mh + ["--process_id", str(pid)],
+                              env=_env(4), cwd=REPO, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE, text=True)
+             for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=900)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"stdout:\n{out}\nstderr:\n{err}"
+        outs.append(out)
+    got = re.findall(r"iter (\d+): val loss ([0-9.]+)", outs[0])
+    assert got == want, (got, want)
+    assert "val loss" not in outs[1]  # reports are process-0-only
