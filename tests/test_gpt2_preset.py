@@ -9,6 +9,7 @@ vocab -> padded vocab-parallel CE over tp=4).
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from distributed_pytorch_from_scratch_tpu import (MeshConfig, Transformer,
                                                   make_mesh)
@@ -20,6 +21,7 @@ from distributed_pytorch_from_scratch_tpu.training.train_step import (
     build_train_step)
 
 
+@pytest.mark.slow  # heaviest of its family; shorter siblings stay fast
 def test_gpt2_124m_preset_trains_on_2d_mesh():
     cfg = model_preset("gpt2-124m")
     # GPT-2-small DIMS (768/3072/12x12/50257/1024); the LLaMA-style arch

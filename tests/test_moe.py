@@ -168,6 +168,7 @@ def test_model_loss_logits_grads_match_single_device(name, shape):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # heaviest of its family; shorter siblings stay fast
 def test_multi_step_history_matches_across_meshes():
     """20 Adam steps: the loss history on dp2 x ep2 x tp2 matches the
     1-device history — no drift from the all_to_all/einsum transposes

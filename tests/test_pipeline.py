@@ -82,6 +82,7 @@ def test_loss_logits_grads_match_single_device(name, dp, pp, tp, m):
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # heaviest of its family; shorter siblings stay fast
 def test_multi_step_history_matches_single_device():
     """20 Adam steps on dp2 x pp2 x tp2 reproduce the 1-device loss history
     (the reference's multi-step equivalence idiom, SURVEY §4 check 3)."""

@@ -178,6 +178,7 @@ def test_pp_ring_cp_train_cli_smoke(corpus):
     assert r["steps"] == 2 and np.isfinite(r["avg_loss"])
 
 
+@pytest.mark.slow  # heaviest of its family; shorter siblings stay fast
 def test_interleaved_train_resume_eval(corpus):
     """The interleaved schedule through the train CLI: checkpoints are
     saved CANONICAL (layers flattened back to the (L, ...) stack), resume
