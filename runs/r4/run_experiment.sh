@@ -68,7 +68,7 @@ fi
 
 if [ ! -s "$R/train.log" ] || ! grep -q "training finished" "$R/train.log"; then
   echo "=== 45M training run ===" | tee -a "$R/session.log"
-  timeout 14400 python -m distributed_pytorch_from_scratch_tpu.train \
+  timeout 5400 python -m distributed_pytorch_from_scratch_tpu.train \
     --data_path "$TOKENS" --save_dir "$R/ckpt" \
     --bf16 --batch_size 32 --maxlen 512 \
     --max_steps 5000 --warmup_steps 500 --lr 3e-4 \
@@ -82,7 +82,7 @@ fi
 # so the parity run above computes ~3x more FLOPs per useful token)
 if [ ! -s "$R/train_packed.log" ] || ! grep -q "training finished" "$R/train_packed.log"; then
   echo "=== 45M packed-mode run (1000 steps) ===" | tee -a "$R/session.log"
-  timeout 3600 python -m distributed_pytorch_from_scratch_tpu.train \
+  timeout 2700 python -m distributed_pytorch_from_scratch_tpu.train \
     --data_path "$TOKENS" --save_dir "$R/ckpt_packed" \
     --data_mode packed \
     --bf16 --batch_size 32 --maxlen 512 \
@@ -93,7 +93,7 @@ if [ ! -s "$R/train_packed.log" ] || ! grep -q "training finished" "$R/train_pac
 fi
 
 echo "=== evaluate: val sweep + decodes ===" | tee -a "$R/session.log"
-timeout 3600 python -m distributed_pytorch_from_scratch_tpu.evaluate \
+timeout 2700 python -m distributed_pytorch_from_scratch_tpu.evaluate \
   --data_path "$TOKENS" --ckpt_dir "$R/ckpt" \
   --tokenizer_path "$R/tokenizer.json" \
   --maxlen 512 --batch_size 8 --max_decode_len 64 \
