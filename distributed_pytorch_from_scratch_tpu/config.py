@@ -182,6 +182,14 @@ class OptimizerConfig:
     # None = off — the reference has no clipping (SURVEY non-goals), so off
     # stays the parity default.
     clip_grad_norm: "float | None" = None
+    # Decoupled weight decay (torch.optim.AdamW semantics: params shrink by
+    # lr*wd BEFORE the Adam step). 0.0 = plain Adam, the reference's setup.
+    weight_decay: float = 0.0
+    # 'onecycle' (reference parity) or 'cosine' (linear warmup over
+    # warmup_steps -> cosine decay to cosine_min_ratio * lr; beta1 fixed —
+    # the standard pretraining schedule the reference lacks).
+    lr_schedule: str = "onecycle"
+    cosine_min_ratio: float = 0.1
 
 
 @dataclass(frozen=True)

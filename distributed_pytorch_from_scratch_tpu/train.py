@@ -42,7 +42,7 @@ from .training.checkpoint import (latest_step, load_checkpoint,
 from .training.metrics import (MetricsWriter, ProfilerTrace,
                                chip_peak_flops, device_memory_gib,
                                model_flops_per_step)
-from .training.optim import init_adam_state, onecycle_lr
+from .training.optim import init_adam_state, schedule_lr
 from .training.train_step import (build_grad_accum_step, build_train_step,
                                   build_train_step_multi)
 from .training.zero import zero1_moment_shardings
@@ -113,6 +113,18 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "clip_grad_norm_ semantics); off by default like "
                         "the reference")
     g.add_argument("--warmup_steps", type=int, default=2000)
+    g.add_argument("--weight_decay", type=float, default=0.0,
+                   help="decoupled weight decay (torch.optim.AdamW "
+                        "semantics); 0 = plain Adam, the reference's setup")
+    g.add_argument("--lr_schedule", choices=["onecycle", "cosine"],
+                   default="onecycle",
+                   help="'onecycle' = reference parity (torch OneCycleLR "
+                        "incl. beta1 cycling); 'cosine' = linear warmup + "
+                        "cosine decay to --cosine_min_ratio x lr, beta1 "
+                        "fixed")
+    g.add_argument("--cosine_min_ratio", type=float, default=0.1,
+                   help="--lr_schedule cosine: final lr as a fraction of "
+                        "--lr")
     g.add_argument("--max_steps", type=int, default=20000)
     g.add_argument("--log_interval", type=int, default=100)
     g.add_argument("--save_interval", type=int, default=1000)
@@ -318,7 +330,10 @@ def train(args: argparse.Namespace) -> dict:
                         remat=REMAT_CHOICES[args.remat])
     ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
                            max_steps=args.max_steps,
-                           clip_grad_norm=args.clip_grad_norm)
+                           clip_grad_norm=args.clip_grad_norm,
+                           weight_decay=args.weight_decay,
+                           lr_schedule=args.lr_schedule,
+                           cosine_min_ratio=args.cosine_min_ratio)
 
     params = model.init(jax.random.key(args.random_seed))
     # count from the actual pytree: exact for every family (cfg.num_params()
@@ -620,7 +635,7 @@ def train(args: argparse.Namespace) -> dict:
                     profiler.maybe_stop(n, sync=loss)
                 accum_loss = accum_loss + loss
                 if n // args.log_interval > prev_n // args.log_interval:
-                    lr, _ = onecycle_lr(ocfg, jnp.asarray(n - 1))
+                    lr, _ = schedule_lr(ocfg, jnp.asarray(n - 1))
                     avg = float(accum_loss) / (n - start_step)
                     dt = time.time() - t_start
                     tps = tokens_since / max(dt, 1e-9)

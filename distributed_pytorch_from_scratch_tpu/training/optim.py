@@ -5,7 +5,10 @@ total_steps, pct_start=warmup/max_steps)` (`/root/reference/train.py:83-84`).
 This module reproduces torch's semantics exactly:
 
 * Adam: bias-corrected first/second moments, eps inside the sqrt's
-  denominator, no weight decay (torch defaults, betas=(0.9, 0.999), eps=1e-8).
+  denominator (torch defaults, betas=(0.9, 0.999), eps=1e-8). With
+  `weight_decay > 0` the update is torch.optim.AdamW's instead: decoupled
+  decay `p *= 1 - lr*wd` applied before the moment update, never entering
+  the moments.
 * OneCycleLR (torch defaults): two cosine phases —
   warmup  `initial_lr = max_lr/div_factor -> max_lr` over pct_start,
   anneal  `max_lr -> initial_lr/final_div_factor` over the rest;
@@ -86,6 +89,32 @@ def onecycle_lr(cfg: OptimizerConfig, step: jax.Array) -> Tuple[jax.Array, jax.A
     return lr, beta1
 
 
+def cosine_lr(cfg: OptimizerConfig, step: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Linear warmup over warmup_steps -> cosine decay to
+    cosine_min_ratio * lr at max_steps. beta1 stays fixed (momentum cycling
+    is a OneCycle-ism). The standard pretraining schedule; the reference
+    only has OneCycle (`/root/reference/train.py:84`)."""
+    stepf = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+    warm = float(max(cfg.warmup_steps, 1))
+    total = float(max(cfg.max_steps - cfg.warmup_steps, 1))
+    min_lr = cfg.lr * cfg.cosine_min_ratio
+    warm_lr = cfg.lr * jnp.minimum(1.0, (stepf + 1.0) / warm)
+    pct = jnp.clip((stepf - cfg.warmup_steps) / total, 0.0, 1.0)
+    decay_lr = _anneal_cos(cfg.lr, min_lr, pct)
+    lr = jnp.where(stepf < cfg.warmup_steps, warm_lr, decay_lr)
+    return lr, jnp.asarray(cfg.betas[0], jnp.float32)
+
+
+def schedule_lr(cfg: OptimizerConfig, step: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(lr, beta1) for this step under cfg.lr_schedule."""
+    if cfg.lr_schedule == "cosine":
+        return cosine_lr(cfg, step)
+    if cfg.lr_schedule != "onecycle":
+        raise ValueError(f"unknown lr_schedule {cfg.lr_schedule!r} "
+                         "(choices: 'onecycle', 'cosine')")
+    return onecycle_lr(cfg, step)
+
+
 def clip_by_global_norm(grads: Any, max_norm: float) -> Any:
     """torch `clip_grad_norm_` semantics: one L2 norm over every grad leaf,
     scaled by max_norm/(norm + 1e-6) only when the norm exceeds max_norm."""
@@ -99,17 +128,20 @@ def clip_by_global_norm(grads: Any, max_norm: float) -> Any:
 
 def adam_update(cfg: OptimizerConfig, params: Any, grads: Any,
                 state: AdamState) -> Tuple[Any, AdamState]:
-    """One Adam step with the OneCycle (lr, beta1) for this step.
+    """One Adam(W) step with this step's scheduled (lr, beta1)
+    (OneCycle incl. cycled beta1, or warmup+cosine — cfg.lr_schedule).
 
     Matches torch.optim.Adam's update exactly:
         mu    <- b1*mu + (1-b1)*g
         nu    <- b2*nu + (1-b2)*g^2
         p     <- p - lr * (mu/(1-b1^t)) / (sqrt(nu/(1-b2^t)) + eps)
+    and torch.optim.AdamW's when cfg.weight_decay > 0 (decay applied to p
+    first; tests/test_optim.py asserts both against torch.optim itself).
     """
     if cfg.clip_grad_norm is not None:
         grads = clip_by_global_norm(grads, cfg.clip_grad_norm)
     step = state.step  # 0-based count of completed steps
-    lr, beta1 = onecycle_lr(cfg, step)
+    lr, beta1 = schedule_lr(cfg, step)
     beta2 = cfg.betas[1]
     t = (step + 1).astype(jnp.float32)
     # Bias correction with a *cycled* beta1: torch computes `1 - beta1**t`
@@ -120,6 +152,10 @@ def adam_update(cfg: OptimizerConfig, params: Any, grads: Any,
 
     def upd(p, g, m, v):
         g = g.astype(p.dtype)
+        if cfg.weight_decay:
+            # torch.optim.AdamW: p.mul_(1 - lr*wd) BEFORE the Adam step
+            # (decoupled decay — never enters the moments)
+            p = p * (1.0 - lr * cfg.weight_decay)
         m_new = beta1 * m + (1.0 - beta1) * g
         v_new = beta2 * v + (1.0 - beta2) * (g * g)
         m_hat = m_new / bc1

@@ -126,3 +126,59 @@ def test_clip_grad_norm_in_adam_update():
     p2, _ = adam_update(cfg_off, params, clipped, init_adam_state(params))
     np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
                                rtol=1e-6)
+
+
+def test_adamw_cosine_training_matches_torch():
+    """AdamW (decoupled weight decay) + warmup/cosine schedule vs
+    torch.optim.AdamW + LambdaLR implementing the identical schedule
+    formula — 150 steps on a quadratic, params track to f32 precision."""
+    import math
+    cfg = OptimizerConfig(lr=1e-2, warmup_steps=30, max_steps=150,
+                          weight_decay=0.1, lr_schedule="cosine")
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(8, 4).astype(np.float32)
+    tgt = rng.randn(8, 4).astype(np.float32)
+
+    def lam(step):  # lr multiplier at 0-based step (cosine_lr's formula)
+        if step < cfg.warmup_steps:
+            return min(1.0, (step + 1) / cfg.warmup_steps)
+        pct = min(1.0, (step - cfg.warmup_steps)
+                  / max(cfg.max_steps - cfg.warmup_steps, 1))
+        lo = cfg.cosine_min_ratio
+        return lo + (1.0 - lo) / 2.0 * (1.0 + math.cos(math.pi * pct))
+
+    wt = torch.nn.Parameter(torch.tensor(w0.copy()))
+    opt = torch.optim.AdamW([wt], lr=cfg.lr, weight_decay=cfg.weight_decay)
+    sched = torch.optim.lr_scheduler.LambdaLR(opt, lam)
+    tgt_t = torch.tensor(tgt)
+
+    params = {"w": jnp.asarray(w0.copy())}
+    state = init_adam_state(params)
+
+    @jax.jit
+    def step_fn(params, state):
+        def loss_fn(p):
+            return jnp.sum((p["w"] - jnp.asarray(tgt)) ** 2)
+        grads = jax.grad(loss_fn)(params)
+        return adam_update(cfg, params, grads, state)
+
+    for _ in range(cfg.max_steps):
+        loss = torch.sum((wt - tgt_t) ** 2)
+        opt.zero_grad(); loss.backward(); opt.step(); sched.step()
+        params, state = step_fn(params, state)
+
+    np.testing.assert_allclose(np.asarray(params["w"]), wt.detach().numpy(),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_cosine_schedule_values():
+    from distributed_pytorch_from_scratch_tpu.training.optim import cosine_lr
+    cfg = OptimizerConfig(lr=1e-3, warmup_steps=10, max_steps=110,
+                          lr_schedule="cosine")
+    lr0, b1 = cosine_lr(cfg, jnp.asarray(0))
+    assert abs(float(lr0) - 1e-4) < 1e-9          # (0+1)/10 of lr
+    assert float(b1) == pytest.approx(0.9)        # beta1 NOT cycled
+    lr_peak, _ = cosine_lr(cfg, jnp.asarray(9))
+    assert float(lr_peak) == pytest.approx(1e-3)  # end of warmup
+    lr_end, _ = cosine_lr(cfg, jnp.asarray(cfg.max_steps))
+    assert float(lr_end) == pytest.approx(1e-4, rel=1e-5)  # min ratio 0.1
