@@ -20,7 +20,7 @@ if [ ! -s "$R/tpu_checks.ok" ]; then
 fi
 
 # ---- bench lines (BENCH_r04 evidence; driver re-runs bench.py itself)
-for spec in "45m:" "gpt2-124m:" "45m-moe8:" "45m:--remat true" \
+for spec in "45m:--remat dots" "gpt2-124m:--remat dots" "45m-moe8:--remat dots" "45m:--remat true" \
             "45m:--remat false" "45m:--decode" "gpt2-124m:--decode --batch 4" \
             "45m:--steps_per_dispatch 16" "45m:--seqlen 8192 --batch 2"; do
   model="${spec%%:*}"; extra="${spec#*:}"

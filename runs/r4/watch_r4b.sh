@@ -15,7 +15,7 @@ LOG=/tmp/tpu_status_r4.txt
 
 complete() {
   [ -s "$R/tpu_checks.ok" ] || return 1
-  for t in 45m gpt2-124m 45m-moe8 45mremattrue 45mrematfalse 45mdecode \
+  for t in 45mrematdots gpt2-124mrematdots 45m-moe8rematdots 45mremattrue 45mrematfalse 45mdecode \
            gpt2-124mdecodebatch4 \
            45msteps_per_dispatch16 45mseqlen8192batch2; do
     [ -s "$R/bench_${t}.json" ] || return 1
