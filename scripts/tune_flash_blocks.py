@@ -117,6 +117,10 @@ def main():
     ap.add_argument("--iters", type=int, default=20)
     args = ap.parse_args()
 
+    # Guarded probe (a hung PJRT init — the documented tunnel-outage mode —
+    # would otherwise block this script forever; see bench._discover_backend)
+    import bench
+    bench._discover_backend(timeout_s=240.0)
     assert jax.devices()[0].platform != "cpu", (
         "run on TPU hardware; devices: %s" % jax.devices())
     print("device:", jax.devices()[0].device_kind)
