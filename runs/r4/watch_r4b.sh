@@ -38,6 +38,7 @@ while true; do
     echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
     sleep 120
   else
+    echo "$(date -u +%FT%TZ) down" >> "$LOG"
     sleep 180
   fi
 done
