@@ -59,9 +59,10 @@ def get_eval_args(argv=None) -> argparse.Namespace:
                         "(ragged final batches are padded with IGNORE_INDEX "
                         "rows, which the masked CE mean drops exactly)")
     g.add_argument("--cp_size", type=int, default=1,
-                   help="context-parallel axis for the validation forward "
-                        "(ring attention over sequence chunks); decoding "
-                        "always runs the cp=1 path on the same params")
+                   help="context-parallel axis: the validation forward AND "
+                        "the KV decoder's prefill shard the sequence over "
+                        "'cp' (ring attention; contiguous layout — zigzag "
+                        "or --no_kv_cache decode on the cp=1 path)")
     g.add_argument("--cp_layout", choices=["contiguous", "zigzag"],
                    default="contiguous",
                    help="sequence layout over the cp ring (see train.py)")
@@ -220,6 +221,20 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
               f"position table size); reduce --max_decode_len to silence")
         buf_len = cap
 
+    cp = getattr(model, "cp_size", 1)
+    if cp > 1 and buf_len % cp:
+        # cp-sharded prefill needs contiguous equal chunks; pad up unless
+        # a learned position table caps the buffer, then step down
+        buf_len += cp - buf_len % cp
+        if cap is not None and buf_len > cap:
+            buf_len -= cp
+            longest = max(len(i) for i in encoded.values())
+            if buf_len < longest + 2:
+                raise SystemExit(
+                    f"cp_size {cp} chunking cannot fit the prompts "
+                    f"({longest + 2} positions) under the learned position "
+                    f"table ({cap}); reduce --cp_size or --max_decode_len")
+
     if use_kv_cache:
         # ONE device dispatch for the whole prompt set: decode_batch handles
         # the mixed prompt lengths (models/decode.py). The reference loops
@@ -302,21 +317,25 @@ def evaluate(args: argparse.Namespace) -> dict:
                                 shuffle=False, drop_last=False)
     vocab_size = dataloader.dataset.vocab_size
     cfg = build_model_config(args, vocab_size)
-    # val loss runs the full dp x cp x tp mesh (pp/ep stay 1 at eval);
-    # decoding runs the cp=1 path on the same params (models/decode.py),
-    # with its batch replicated over dp/cp.
+    # val loss runs the full dp x cp x tp mesh (pp/ep stay 1 at eval).
+    # Decoding: with the contiguous layout the KV decoder itself shards
+    # the prefill over 'cp' (ring attention, models/decode.py); the zigzag
+    # layout permutes the cache order, and the full-recompute path
+    # (--no_kv_cache) is single-device dense attention — both decode on
+    # the cp=1 path.
+    dec_cp = (args.cp_size if (args.cp_layout == "contiguous"
+                               and not args.no_kv_cache) else 1)
     if args.family == "gpt2":
         from .models.gpt2 import GPT2Transformer
         model_val = GPT2Transformer(cfg, tp_size=args.tp_size,
                                     cp_size=args.cp_size,
                                     cp_layout=args.cp_layout)
-        # decoding always runs the cp=1 path on the same params, like llama
-        model = GPT2Transformer(cfg, tp_size=args.tp_size)
+        model = GPT2Transformer(cfg, tp_size=args.tp_size, cp_size=dec_cp)
     else:
         model_val = Transformer(cfg, tp_size=args.tp_size,
                                 cp_size=args.cp_size,
                                 cp_layout=args.cp_layout)
-        model = Transformer(cfg, tp_size=args.tp_size)
+        model = Transformer(cfg, tp_size=args.tp_size, cp_size=dec_cp)
     template = model.init(jax.random.key(args.random_seed))
     loss_fn = model_val.make_doc_loss(mesh)
     feed = batch_feeder(mesh)

@@ -35,6 +35,12 @@ def get_generate_args(argv=None) -> argparse.Namespace:
                    help="checkpoint iteration (default: latest)")
     p.add_argument("--max_new_tokens", type=int, default=128)
     p.add_argument("--tp_size", type=int, default=1)
+    p.add_argument("--cp_size", type=int, default=1,
+                   help="shard the PREFILL's sequence over a 'cp' mesh axis "
+                        "(ring attention — prompts far beyond one chip's "
+                        "attention budget); the per-token loop runs on the "
+                        "gathered caches; the buffer pads to a multiple of "
+                        "cp_size")
     p.add_argument("--family", choices=["llama", "gpt2"], default="llama")
     add_model_shape_args(p.add_argument_group("model shape"))
     p.add_argument("--temperature", type=float, default=0.0,
@@ -62,12 +68,14 @@ def generate(args: argparse.Namespace) -> list:
                          f"{BOS_TOKEN}/{EOS_TOKEN} specials")
 
     cfg = build_model_config(args, vocab_size)
-    mesh = make_mesh(MeshConfig(tp=args.tp_size))
+    mesh = make_mesh(MeshConfig(tp=args.tp_size, cp=args.cp_size))
     if args.family == "gpt2":
         from .models.gpt2 import GPT2Transformer
-        model = GPT2Transformer(cfg, tp_size=args.tp_size)
+        model = GPT2Transformer(cfg, tp_size=args.tp_size,
+                                cp_size=args.cp_size)
     else:
-        model = Transformer(cfg, tp_size=args.tp_size)
+        model = Transformer(cfg, tp_size=args.tp_size,
+                            cp_size=args.cp_size)
 
     step = args.iter if args.iter is not None else latest_step(args.ckpt_dir)
     if step is None:
@@ -87,6 +95,15 @@ def generate(args: argparse.Namespace) -> list:
         if buf_len < longest + 2:
             raise SystemExit(f"prompt needs {longest + 2} positions but the "
                              f"model's position table has {cap}")
+    if args.cp_size > 1 and buf_len % args.cp_size:
+        buf_len += args.cp_size - buf_len % args.cp_size  # contiguous chunks
+        if cap is not None and buf_len > cap:
+            buf_len -= args.cp_size  # stay under the position table
+            if buf_len < longest + 2:
+                raise SystemExit(
+                    f"cp_size {args.cp_size} chunking cannot fit the prompt "
+                    f"({longest + 2} positions) under the position table "
+                    f"({cap})")
     dec = GreedyDecoder(model, mesh, buf_len,
                         temperature=args.temperature,
                         top_k=args.decode_top_k, top_p=args.decode_top_p)

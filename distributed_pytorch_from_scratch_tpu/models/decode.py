@@ -19,8 +19,10 @@ Layout: caches are (num_layers, b, local_KV_heads, buf_len, head_dim),
 sharded over 'tp' on the heads dim — the same head partitioning as training,
 so the same checkpoint params work unchanged; under grouped-query attention
 the caches are num_heads/num_kv_heads x smaller than the query-head count
-(the GQA decode memory win). Decode is TP-only (dp=cp=1), like the
-reference's eval (`test.py` runs the TP mesh it trained with).
+(the GQA decode memory win). With a cp-sharded model (ring + contiguous
+layout) the PREFILL also shards the prompt over 'cp' and runs ring
+attention — long-context generation — while the per-token loop stays
+replicated on the gathered caches (`_prefill_cp`).
 
 The decoder is generic over the model FAMILY via three hooks each family
 class declares (`uses_rope`, `attn_norm_key`, `ffn_norm_key`) plus duck
@@ -42,6 +44,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import resolve_dtype
 from ..ops.attention import MASK_VALUE, causal_attention
 from ..ops.collectives import gather_from
+from ..ops.ring_attention import ring_attention
 from ..ops.rope import apply_rotary, rope_tables
 from .transformer import NEG_INF, Transformer
 
@@ -156,6 +159,72 @@ def _prefill(model: Transformer, params: Params, buf: jax.Array,
     return ks.astype(dtype), vs.astype(dtype), _logits_last(model, params, last, dtype)
 
 
+def _prefill_cp(model: Transformer, params: Params, buf: jax.Array,
+                prompt_len: jax.Array, cos_t, sin_t, dtype):
+    """Context-parallel prefill: the buffer's sequence dim shards over the
+    'cp' mesh axis (contiguous chunks) and every layer's attention runs the
+    ring (`ops/ring_attention.ring_attention`) — the same long-context path
+    training uses, so a prompt far longer than one chip's O(t^2) budget
+    prefills across the cp group. The per-layer K/V chunks are then
+    `lax.all_gather`ed back to full length: the decode LOOP stays
+    replicated over cp (each single-token step is cheap and identical on
+    every shard), which keeps cache-write indexing trivial while the
+    quadratic prefill work and its activations split cp-ways.
+
+    `buf` here is the REPLICATED (b, buf_len) buffer; each shard slices its
+    contiguous chunk by `axis_index('cp')`. Returns full-length (ks, vs)
+    and the per-row logits at prompt_len-1, exactly like `_prefill` — the
+    outputs are cp-INVARIANT (the chunk psum below clears the tag), so
+    the caller's decode loop runs unchanged."""
+    b, t = buf.shape
+    cp = lax.axis_size("cp")
+    tl = t // cp
+    i = lax.axis_index("cp")
+    local = lax.dynamic_slice_in_dim(buf, i * tl, tl, axis=1)
+    pos = i * tl + jnp.tile(jnp.arange(tl, dtype=jnp.int32)[None, :], (b, 1))
+    x = _embed(model, params, local, pos, dtype)
+    if model.uses_rope:
+        cos = jnp.take(cos_t, pos, axis=0, mode="clip")
+        sin = jnp.take(sin_t, pos, axis=0, mode="clip")
+
+    def body(x, lp):
+        nk = model.attn_norm_key
+        y = model._mods[nk].apply(lp[nk], x)
+        q, k, v = _qkv(model, lp, y, dtype)
+        if model.uses_rope:
+            q, k = apply_rotary(q, k, cos, sin)
+        o = ring_attention(q, k, v, q_pos=pos, axis="cp",
+                           impl=model.attn_impl).astype(x.dtype)
+        x = _finish_block(model, lp, x, o, dtype)
+        return x, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+
+    # Chunks -> full length in ONE collective that also clears the
+    # cp-varying tag: each shard scatters its chunk into a zeros
+    # full-length buffer and the psum of the disjoint chunks IS the
+    # concatenation (psum output is cp-invariant, so the decode loop
+    # below runs identically on every shard with no extra casts).
+    def to_full(z, seq_axis):
+        shape = z.shape[:seq_axis] + (t,) + z.shape[seq_axis + 1:]
+        full = lax.dynamic_update_slice_in_dim(
+            jnp.zeros(shape, z.dtype), z, i * tl, axis=seq_axis)
+        return lax.psum(full, "cp")
+
+    ks = to_full(ks, 3)                      # (L, b, kvh, t, hd)
+    vs = to_full(vs, 3)
+    # The logits need ONE position per row (prompt_len-1): the shard whose
+    # chunk holds it contributes the (b, 1, d) slice and the psum selects
+    # it — no full-length (b, t, d) gather on the long-context path.
+    idx = (prompt_len - 1).astype(jnp.int32)             # (b,) global
+    in_chunk = (idx >= i * tl) & (idx < (i + 1) * tl)    # (b,)
+    sel = jnp.take_along_axis(
+        x, jnp.clip(idx - i * tl, 0, tl - 1)[:, None, None], axis=1)
+    last = lax.psum(jnp.where(in_chunk[:, None, None], sel, 0), "cp")
+    return ks.astype(dtype), vs.astype(dtype), _logits_last(
+        model, params, last, dtype)
+
+
 def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
                 token: jax.Array, cur: jax.Array, buf_len: int,
                 cos_t, sin_t, dtype):
@@ -250,8 +319,15 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
         if model.uses_rope:
             cos_t, sin_t = rope_tables(table_len, cfg.head_dim,
                                        cfg.rope_theta)
-        ks, vs, logits = _prefill(model, params, buf, prompt_len,
-                                  cos_t, sin_t, dtype)
+        if model.cp_size > 1:
+            # cp-sharded ring prefill; the decode loop below stays
+            # replicated over cp (outputs carry identical values, pmax
+            # clears the varying tag)
+            ks, vs, logits = _prefill_cp(model, params, buf, prompt_len,
+                                         cos_t, sin_t, dtype)
+        else:
+            ks, vs, logits = _prefill(model, params, buf, prompt_len,
+                                      cos_t, sin_t, dtype)
 
         def next_token(logits, cur):
             # gather the tp vocab shards; every shard then computes the
@@ -353,9 +429,22 @@ class GreedyDecoder:
     def __init__(self, model: Transformer, mesh: Mesh, buf_len: int,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 0.0):
-        if model.cp_size != 1:
-            raise ValueError("decode is TP-only; build the decoder with a "
-                             "cp_size=1 model (same params load fine)")
+        if model.cp_size > 1:
+            # Long-context decode: the PREFILL runs the same ring-attention
+            # path as training (sequence sharded over 'cp'), so prompts far
+            # beyond one chip's attention budget prefill across the group;
+            # the per-token loop then runs on the gathered caches,
+            # replicated over cp. Contiguous layout + ring only (zigzag
+            # would permute the cache order; ulysses needs head headroom).
+            if model.cp_impl != "ring" or model.cp_layout != "contiguous":
+                raise ValueError(
+                    "cp decode supports cp_impl='ring' with the contiguous "
+                    f"layout (got impl={model.cp_impl!r}, "
+                    f"layout={model.cp_layout!r})")
+            if buf_len % model.cp_size:
+                raise ValueError(f"buf_len {buf_len} must be divisible by "
+                                 f"cp_size {model.cp_size} (contiguous "
+                                 f"chunks)")
         cap = getattr(model, "max_decode_positions", None)
         if cap is not None and buf_len > cap:
             raise ValueError(

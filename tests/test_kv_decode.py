@@ -71,11 +71,14 @@ def test_kv_respects_buffer_and_limits():
     assert len(prompt) + len(got) <= BUF
 
 
-def test_kv_rejects_cp_model():
+def test_kv_cp_model_accepted_ring_contiguous_only():
+    # cp decode is supported for ring+contiguous (TestContextParallelDecode);
+    # other cp configs still reject with a clear error
     mesh = make_mesh(MeshConfig(dp=1, cp=2, tp=2))
-    model = Transformer(CFG, tp_size=2, cp_size=2)
-    with pytest.raises(ValueError, match="cp_size=1"):
-        GreedyDecoder(model, mesh, BUF)
+    GreedyDecoder(Transformer(CFG, tp_size=2, cp_size=2), mesh, BUF)  # ok
+    with pytest.raises(ValueError, match="ring"):
+        GreedyDecoder(Transformer(CFG, tp_size=2, cp_size=2,
+                                  cp_impl="ulysses"), mesh, BUF)
 
 
 @pytest.mark.parametrize("tp", [1, 4])
@@ -256,3 +259,64 @@ def test_per_row_total_length_limits():
     solo = dec.decode_batch(params, [short], eos_id=-1,
                             max_total_len=len(short) + 4)[0]
     assert gens[0] == solo, (gens[0], solo)
+
+
+class TestContextParallelDecode:
+    """Long-context decode: the prefill shards the prompt over 'cp' and runs
+    ring attention (the training long-context path); the decode loop runs on
+    the gathered caches. Token-for-token equal to the cp=1 decoder."""
+
+    @pytest.mark.parametrize("cp,tp", [(2, 1), (2, 2), (4, 2)])
+    def test_cp_decode_matches_cp1(self, cp, tp):
+        mesh = make_mesh(MeshConfig(cp=cp, tp=tp))
+        base = Transformer(CFG, tp_size=tp)
+        cp_model = Transformer(CFG, tp_size=tp, cp_size=cp)
+        params = jax.device_put(base.init(jax.random.key(11)),
+                                base.shardings(mesh))
+        prompts = [[0, 5, 17, 33, 60], [0, 7, 9]]
+        want = GreedyDecoder(base, mesh, BUF).decode_batch(
+            params, prompts, EOS, max_total_len=24)
+        got = GreedyDecoder(cp_model, mesh, BUF).decode_batch(
+            params, prompts, EOS, max_total_len=24)
+        assert got == want, (cp, tp, got, want)
+
+    def test_cp_decode_gqa(self):
+        mesh = make_mesh(MeshConfig(cp=2, tp=2))
+        cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8,
+                          num_kv_heads=2, num_layers=2, vocab_size=96,
+                          maxlen=64)
+        base = Transformer(cfg, tp_size=2)
+        cp_model = Transformer(cfg, tp_size=2, cp_size=2)
+        params = jax.device_put(base.init(jax.random.key(5)),
+                                base.shardings(mesh))
+        prompt = [0, 3, 5, 7, 11, 13]
+        want = GreedyDecoder(base, mesh, BUF).decode(
+            params, prompt, EOS, max_total_len=20)
+        got = GreedyDecoder(cp_model, mesh, BUF).decode(
+            params, prompt, EOS, max_total_len=20)
+        assert got == want
+
+    def test_cp_decode_gpt2(self):
+        from distributed_pytorch_from_scratch_tpu.models.gpt2 import (
+            GPT2Transformer)
+        cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4,
+                          num_layers=2, vocab_size=96, maxlen=64)
+        mesh = make_mesh(MeshConfig(cp=2, tp=2))
+        base = GPT2Transformer(cfg, tp_size=2)
+        cp_model = GPT2Transformer(cfg, tp_size=2, cp_size=2)
+        params = jax.device_put(base.init(jax.random.key(9)),
+                                base.shardings(mesh))
+        prompt = [0, 4, 8, 15, 16, 23, 42]
+        want = GreedyDecoder(base, mesh, BUF).decode(
+            params, prompt, EOS, max_total_len=20)
+        got = GreedyDecoder(cp_model, mesh, BUF).decode(
+            params, prompt, EOS, max_total_len=20)
+        assert got == want
+
+    def test_cp_decode_rejects_bad_configs(self):
+        cp_model = Transformer(CFG, tp_size=1, cp_size=2, cp_impl="ulysses")
+        mesh = make_mesh(MeshConfig(cp=2))
+        with pytest.raises(ValueError, match="ring"):
+            GreedyDecoder(cp_model, mesh, BUF)
+        with pytest.raises(ValueError, match="divisible"):
+            GreedyDecoder(Transformer(CFG, tp_size=1, cp_size=2), mesh, 31)
