@@ -33,9 +33,23 @@ while true; do
   fi
   if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" \
       >/dev/null 2>&1; then
-    echo "$(date -u +%FT%TZ) UP — (re)launching run_experiment.sh" >> "$LOG"
-    bash "$R/run_experiment.sh" >> "$R/launcher.log" 2>&1
-    echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
+    # Time-aware: the driver benches the chip itself at round end — a full
+    # session started late would still hold the (single-tenant) chip then.
+    # Before 14:00 UTC: full session. 14:00-15:10: trimmed priority pass
+    # (kernel checks + two short bench lines). After 15:10: stand down.
+    hhmm=$(date -u +%H%M)
+    if [ "$hhmm" -lt 1400 ]; then
+      echo "$(date -u +%FT%TZ) UP — (re)launching run_experiment.sh" >> "$LOG"
+      bash "$R/run_experiment.sh" >> "$R/launcher.log" 2>&1
+      echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
+    elif [ "$hhmm" -lt 1510 ]; then
+      echo "$(date -u +%FT%TZ) UP — late window, priority pass only" >> "$LOG"
+      bash "$R/run_priority.sh" >> "$R/launcher.log" 2>&1
+      echo "$(date -u +%FT%TZ) priority pass exited rc=$?" >> "$LOG"
+    else
+      echo "$(date -u +%FT%TZ) UP — standing down (driver bench window)" >> "$LOG"
+      exit 0
+    fi
     sleep 120
   else
     echo "$(date -u +%FT%TZ) down" >> "$LOG"
