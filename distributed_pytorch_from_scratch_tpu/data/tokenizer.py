@@ -126,7 +126,9 @@ def pre_tokenize(input_file: str, output_file: str, tokenizer_file: str,
     return out
 
 
-def main(argv=None):
+def parse_args(argv=None):
+    """Parse-only entry (the staged-session preflight test validates the
+    hardware session's command lines against this exact parser)."""
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -142,8 +144,11 @@ def main(argv=None):
     e.add_argument("--splits", "-s", nargs="+", default=["train", "validation"])
     e.add_argument("--backend", choices=["auto", "native", "hf"],
                    default="auto")
+    return p.parse_args(argv)
 
-    args = p.parse_args(argv)
+
+def main(argv=None):
+    args = parse_args(argv)
     if args.cmd == "train":
         train_bpe(args.data_path, args.output_path, args.vocab_size)
     else:

@@ -470,13 +470,14 @@ def train(args: argparse.Namespace) -> dict:
         """Cross-host-consistent shutdown decision. schedule_save runs a
         collective in multi-host mode, so acting on a process-local signal
         would send one process into an all-gather the others never enter
-        (deadlock). Process 0's flag is broadcast and every process acts on
-        THAT; a signal delivered only to a non-zero process is ignored
-        (schedulers deliver preemption to every host — and the single-host
-        case never takes this path). The broadcast blocks on device_get, so
-        inside the loop (`step` given) it runs only once per log_interval
-        steps: preemption reaction lags up to that many steps, and host
-        dispatch stays async in between."""
+        (deadlock). Every process contributes its local flag and the
+        MAX (any-of) is what all of them act on — same collective cost as
+        a broadcast, but a SIGTERM delivered to only one host (some
+        schedulers signal a single rank) still wins a shutdown checkpoint
+        everywhere (ADVICE r4). The gather blocks on device_get, so inside
+        the loop (`step` given) it runs only once per log_interval steps:
+        preemption reaction lags up to that many steps, and host dispatch
+        stays async in between."""
         if nproc == 1:
             return shutdown.requested
         if step is not None:
@@ -484,8 +485,8 @@ def train(args: argparse.Namespace) -> dict:
                     and step - _last_poll[0] < args.log_interval):
                 return False
             _last_poll[0] = step
-        return bool(multihost_utils.broadcast_one_to_all(
-            np.int32(shutdown.requested if is_main else 0)))
+        return bool(np.max(multihost_utils.process_allgather(
+            np.int32(shutdown.requested))))
     last_saved = start_step
     pending_save = None  # at most one async checkpoint write in flight
     replicate_fn = []  # lazily-built jitted all-gather for multi-host saves

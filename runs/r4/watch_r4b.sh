@@ -5,7 +5,9 @@
 # session is complete. This watcher keys on the actual artifacts and keeps
 # relaunching the idempotent run_experiment.sh until they all exist:
 #   - tpu_checks.ok
-#   - all 6 bench_*.json lines
+#   - all 9 bench_*.json lines (the list grew when the --decode /
+#     --remat-false / spd16 / t=8k lines were added; complete() below is
+#     the source of truth)
 #   - train.log + train_packed.log with "training finished"
 #   - eval.log with at least one "val loss" line
 # Probe log: /tmp/tpu_status_r4.txt (shared with probe_tunnel.sh).

@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 hardware watcher. Artifact-keyed (ADVICE r4: the completion list
+# in this header IS the list complete() checks — keep them in sync):
+#   - kernel_checks.json with "all_ok": true
+#   - train.log with "training finished" and eval.log with "val loss"
+#   - all 9 bench_*.json lines (45mrematfalse 45mdecode 45mspd16
+#     45mbreakdown 45mt8k 45m-moe8 45mremattrue gpt2-124mdecode
+#     gpt2-124mrematfalse)
+#   - tune_blocks.log with BEST, train_packed.log finished
+# Probes the tunnel under timeout (a down tunnel HANGS PJRT init, never
+# errors); on tunnel-up launches the idempotent run_experiment.sh.
+# Time-aware standdown: the driver runs its own bench at round end
+# (~04:55 UTC Aug 1) on the single-tenant chip — full sessions until 03:10,
+# priority passes until 04:10, then exit.
+set -u
+R=/root/repo/runs/r5
+LOG=/tmp/tpu_status_r5.txt
+
+complete() {
+  grep -q '"all_ok": true' "$R/kernel_checks.json" 2>/dev/null || return 1
+  for t in 45mrematfalse 45mdecode 45mspd16 45mbreakdown 45mt8k 45m-moe8 \
+           45mremattrue gpt2-124mdecode gpt2-124mrematfalse; do
+    [ -s "$R/bench_${t}.json" ] || return 1
+    # an error payload (tunnel dropped mid-line) is NOT a measured number —
+    # bench_line deletes these before re-running; completion must agree
+    grep -q '"error"' "$R/bench_${t}.json" && return 1
+  done
+  grep -q "training finished" "$R/train.log" 2>/dev/null || return 1
+  grep -q "training finished" "$R/train_packed.log" 2>/dev/null || return 1
+  grep -q "val loss" "$R/eval.log" 2>/dev/null || return 1
+  grep -q "BEST" "$R/tune_blocks.log" 2>/dev/null || return 1
+  return 0
+}
+
+while true; do
+  if complete; then
+    echo "$(date -u +%FT%TZ) session artifacts complete — watcher exiting" >> "$LOG"
+    exit 0
+  fi
+  # -k 10: a hung PJRT init ignores SIGTERM (the documented outage mode);
+  # without the follow-up SIGKILL a wedged probe would hold the
+  # single-tenant tunnel forever and starve every later window
+  if timeout -k 10 90 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'" \
+      >/dev/null 2>&1; then
+    now=$(date -u +%Y%m%d%H%M)
+    if [ "$now" -lt 202608010310 ]; then
+      echo "$(date -u +%FT%TZ) UP — (re)launching run_experiment.sh" >> "$LOG"
+      bash "$R/run_experiment.sh" >> "$R/launcher.log" 2>&1
+      echo "$(date -u +%FT%TZ) experiment script exited rc=$?" >> "$LOG"
+    elif [ "$now" -lt 202608010410 ]; then
+      echo "$(date -u +%FT%TZ) UP — late window, priority pass only" >> "$LOG"
+      bash "$R/run_priority.sh" >> "$R/launcher.log" 2>&1
+      echo "$(date -u +%FT%TZ) priority pass exited rc=$?" >> "$LOG"
+    else
+      echo "$(date -u +%FT%TZ) UP — standing down (driver bench window)" >> "$LOG"
+      exit 0
+    fi
+    sleep 120
+  else
+    echo "$(date -u +%FT%TZ) down" >> "$LOG"
+    sleep 180
+  fi
+done

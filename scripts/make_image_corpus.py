@@ -116,13 +116,17 @@ def harvest(root: str, max_docs: int, seed: int):
     return docs
 
 
-def main(argv=None):
+def parse_args(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("out")
     ap.add_argument("--root", default=os.path.dirname(os.__file__))
     ap.add_argument("--max_docs", type=int, default=400_000)
     ap.add_argument("--seed", type=int, default=42)
-    args = ap.parse_args(argv)
+    return ap.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
 
     docs = harvest(args.root, args.max_docs, args.seed)
     # reference split semantics: shuffle, 99/1 (preprocess_data.py:14,31)

@@ -1,0 +1,45 @@
+"""Refresh BASELINE.md's auto-collected hardware-results section from any
+runs/rN/RESULTS.md.
+
+Round-agnostic successor to refresh_baseline_results.py (VERDICT r4 #6:
+that one hardcoded /root/repo and runs/r4). The section heading is derived
+from the runs-dir name, so `runs/r5` maintains its own "Round-5 hardware
+results (auto-collected)" section and never clobbers round-4's record.
+
+Usage: python scripts/refresh_baseline.py runs/r5
+"""
+
+import argparse
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def refresh(runs_dir, baseline_path=None):
+    baseline_path = baseline_path or os.path.join(REPO, "BASELINE.md")
+    name = os.path.basename(os.path.normpath(runs_dir))
+    m = re.fullmatch(r"r(\d+)", name)
+    if not m:
+        raise SystemExit(f"runs dir must be named rN, got: {name}")
+    heading = f"## Round-{m.group(1)} hardware results (auto-collected)"
+    results_path = os.path.join(runs_dir, "RESULTS.md")
+    if not os.path.exists(results_path):
+        raise SystemExit(f"missing {results_path} — run summarize_run.py first")
+    res = open(results_path).read()
+    base = open(baseline_path).read()
+    base = re.sub(rf"\n{re.escape(heading)}\n[\s\S]*?(?=\n## |\Z)", "", base)
+    with open(baseline_path, "w") as f:
+        f.write(base.rstrip("\n") + f"\n\n{heading}\n\n" + res)
+    print(f"{baseline_path}: '{heading}' section refreshed from {results_path}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("runs_dir", help="e.g. runs/r5")
+    args = p.parse_args(argv)
+    refresh(args.runs_dir)
+
+
+if __name__ == "__main__":
+    main()

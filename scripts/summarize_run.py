@@ -1,0 +1,145 @@
+"""Collect a hardware session's artifacts from runs/rN/ into RESULTS.md.
+
+Round-agnostic successor to runs/r4/summarize.py (VERDICT r4 #6: the
+per-round copy hardcoded its directory and silently regenerated stale
+sections). Takes the runs directory as an argument; missing artifacts are
+reported as pending, never errors, so it is safe to run at any point in a
+partially-completed session.
+
+Usage: python scripts/summarize_run.py runs/r5
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+
+
+def bench_lines(rdir):
+    rows = []
+    for p in sorted(glob.glob(os.path.join(rdir, "bench_*.json"))):
+        tag = os.path.basename(p)[len("bench_"):-len(".json")]
+        try:
+            rec = json.loads(open(p).read().strip().splitlines()[-1])
+        except Exception as e:  # noqa: BLE001
+            rows.append(f"| {tag} | unparseable ({e}) | — | — |")
+            continue
+        if "error" in rec:
+            rows.append(f"| {tag} | {rec['error']} | — | — |")
+        elif rec.get("unit") == "tokens/sec/chip":
+            mfu = rec.get("vs_baseline", 0) * 0.30 * 100
+            rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
+                        f"| {mfu:.1f}% | {rec.get('metric')} |")
+        elif rec.get("unit") == "ms/step":  # --breakdown accounting line
+            comp = rec.get("components", {})
+            detail = ", ".join(f"{k}={v}" for k, v in comp.items())
+            rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
+                        f"| x{rec.get('vs_baseline')} dispatch gain "
+                        f"| {detail or rec.get('metric')} |")
+        else:  # decode line: vs_baseline is a per-stream speedup, not MFU
+            rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
+                        f"| x{rec.get('vs_baseline')} vs reference decode "
+                        f"| {rec.get('metric')} |")
+    return rows
+
+
+def train_summary(rdir, log_name):
+    path = os.path.join(rdir, log_name)
+    if not os.path.exists(path):
+        return None
+    text = open(path, errors="replace").read()
+    steps = re.findall(r"step (\d+)/(\d+) -> avg loss ([0-9.]+).*?"
+                       r"([0-9.]+)k tok/s(?: \((\d+)% useful\))?, "
+                       r"MFU ([0-9.]+)%", text)
+    done = "training finished" in text
+    if not steps:
+        return f"{log_name}: no step lines yet (done={done})"
+    first, last = steps[0], steps[-1]
+    return (f"{log_name}: {'finished' if done else 'IN PROGRESS'} — "
+            f"step {last[0]}/{last[1]}, loss {first[2]} -> {last[2]}, "
+            f"{last[3]}k tok/s"
+            + (f" ({last[4]}% useful)" if last[4] else "")
+            + f", MFU {last[5]}%")
+
+
+def eval_summary(rdir):
+    path = os.path.join(rdir, "eval.log")
+    if not os.path.exists(path):
+        return [], []
+    text = open(path, errors="replace").read()
+    vals = re.findall(r"iter (\d+): val loss ([0-9.]+)", text)
+    # decode lines only — warnings ('clamping decode buffer 128 -> 64')
+    # also contain ' -> ' and must not displace real decodes
+    decodes = [(a, b) for a, b in re.findall(r"^(.*?) -> (.*)$", text, re.M)
+               if not a.startswith("Warning") and "clamping" not in a]
+    return vals, decodes[:8]
+
+
+def manifest_failures(rdir):
+    """Steps that failed, from the run_step manifest — forensics inline."""
+    path = os.path.join(rdir, "session_manifest.jsonl")
+    if not os.path.exists(path):
+        return []
+    rows = []
+    for line in open(path, errors="replace"):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("rc", 0) != 0:
+            why = "timeout" if rec.get("timed_out") else f"rc={rec['rc']}"
+            tail = rec.get("stderr_tail", "").strip().splitlines()
+            rows.append(f"- `{rec.get('name')}` {why} after "
+                        f"{rec.get('secs')}s"
+                        + (f" — `{tail[-1][:160]}`" if tail else ""))
+    return rows
+
+
+def summarize(rdir):
+    name = os.path.basename(os.path.normpath(rdir))
+    out = [f"Collected from `{rdir}/` by `scripts/summarize_run.py` after "
+           "the on-hardware session.", ""]
+    rows = bench_lines(rdir)
+    if rows:
+        out.append("| bench line | result | MFU | metric |")
+        out.append("|---|---|---|---|")
+        out.extend(rows)
+    else:
+        out.append("Bench lines: none produced yet.")
+    out.append("")
+    for log in ("train.log", "train_packed.log"):
+        s = train_summary(rdir, log)
+        out.append(s if s else f"{log}: not started.")
+    vals, decodes = eval_summary(rdir)
+    if vals:
+        out.append("")
+        out.append("Validation loss per checkpoint: "
+                   + ", ".join(f"iter {i}: {v}" for i, v in vals))
+    if decodes:
+        out.append("")
+        out.append("Decoded prompts (first 8):")
+        out.extend(f"- `{p.strip()}` -> `{d.strip()}`" for p, d in decodes)
+    fails = manifest_failures(rdir)
+    if fails:
+        out.append("")
+        out.append(f"Failed steps ({name} session manifest):")
+        out.extend(fails)
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("runs_dir", help="e.g. runs/r5")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.runs_dir):
+        raise SystemExit(f"not a directory: {args.runs_dir}")
+    text = summarize(args.runs_dir)
+    out_path = os.path.join(args.runs_dir, "RESULTS.md")
+    with open(out_path, "w") as f:
+        f.write(text)
+    print(f"wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Compiled-on-hardware validation of the Pallas kernels (they run
+interpreted on CPU in the test suite): GQA-routed flash fwd+bwd at both the
+fused and split block paths, the positional block kernel (ring attention's
+building block) fwd + lse + bwd, compiled on the real chip.
+
+Round-agnostic home of runs/r3/tpu_checks.py (VERDICT r4 #2: the staged
+copy 404'd / had a sys.path bug in the only live window; this version also
+times each check and writes a machine-readable artifact).
+
+Usage: python scripts/tpu_checks.py [--out runs/r5/kernel_checks.json]
+Prints PASS/FAIL lines with per-kernel compile+run timings; exits nonzero
+on any mismatch. The JSON artifact records {name, err, atol, ok, secs} per
+check plus the device kind.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Runnable from anywhere: `python scripts/tpu_checks.py` puts scripts/ (not
+# the repo root) on sys.path, so the package import below needs the root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_from_scratch_tpu.ops.attention import (  # noqa: E402
+    causal_attention_xla)
+from distributed_pytorch_from_scratch_tpu.ops.pallas.flash_attention import (  # noqa: E402
+    block_attention, flash_attention)
+
+RESULTS = []
+
+
+def check(name, fn_got, want, atol):
+    """Time compile+first-run of fn_got, compare against want."""
+    t0 = time.time()
+    got = jax.block_until_ready(fn_got())
+    secs = time.time() - t0
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    passed = err <= atol
+    RESULTS.append({"name": name, "err": err, "atol": atol, "ok": passed,
+                    "secs": round(secs, 2)})
+    print(f"{'PASS' if passed else 'FAIL'} {name}: max err {err:.2e} "
+          f"(atol {atol}) in {secs:.1f}s", flush=True)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default=None,
+                   help="write a JSON artifact with per-check results")
+    p.add_argument("--allow_cpu", action="store_true",
+                   help="skip the hardware assert (kernels run interpreted "
+                        "— preflight/debug only, not on-chip evidence)")
+    return p.parse_args(argv)
+
+
+def main():
+    args = parse_args()
+    if not args.allow_cpu:
+        assert jax.devices()[0].platform != "cpu", jax.devices()
+
+    key = jax.random.key(0)
+    loss = lambda fn: lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    # --- GQA-routed flash attention, fused (t <= block) and split paths
+    for tag, t, blk, dtype in [("fused", 512, 1024, jnp.bfloat16),
+                               ("split", 1000, 512, jnp.bfloat16)]:
+        b, hq, hkv, d = 2, 8, 2, 64
+        q = jax.random.normal(jax.random.fold_in(key, 1), (b, hq, t, d), dtype)
+        k = jax.random.normal(jax.random.fold_in(key, 2), (b, hkv, t, d), dtype)
+        v = jax.random.normal(jax.random.fold_in(key, 3), (b, hkv, t, d), dtype)
+        ref = causal_attention_xla(q, k, v)
+        flash = lambda q, k, v: flash_attention(q, k, v, block_q=blk,
+                                                block_k=blk)
+        check(f"gqa flash fwd [{tag}]",
+              lambda: jax.jit(flash)(q, k, v), ref, 3e-2)
+        g_ref = jax.jit(jax.grad(loss(causal_attention_xla),
+                                 argnums=(0, 1, 2)))(q, k, v)
+        g_out = None
+        t0 = time.time()
+        g_out = jax.block_until_ready(
+            jax.jit(jax.grad(loss(flash), argnums=(0, 1, 2)))(q, k, v))
+        bwd_secs = time.time() - t0
+        for n_, ref_g, got_g in zip("qkv", g_ref, g_out):
+            atol = 3e-1 * max(1.0, float(jnp.max(jnp.abs(ref_g))))
+            err = float(jnp.max(jnp.abs(got_g.astype(jnp.float32)
+                                        - ref_g.astype(jnp.float32))))
+            passed = err <= atol
+            RESULTS.append({"name": f"gqa flash d{n_} [{tag}]", "err": err,
+                            "atol": atol, "ok": passed,
+                            "secs": round(bwd_secs, 2)})
+            print(f"{'PASS' if passed else 'FAIL'} gqa flash d{n_} [{tag}]: "
+                  f"max err {err:.2e} (atol {atol:.2e})", flush=True)
+
+    # --- positional block kernel (ring attention building block) fwd + lse
+    from distributed_pytorch_from_scratch_tpu.ops.ring_attention import (
+        _block_attn_xla)
+
+    b, hq, hkv, tq, tk, d = 2, 4, 2, 500, 500, 64
+    q = jax.random.normal(jax.random.fold_in(key, 5), (b, hq, tq, d),
+                          jnp.bfloat16)
+    k = jax.random.normal(jax.random.fold_in(key, 6), (b, hkv, tk, d),
+                          jnp.bfloat16)
+    v = jax.random.normal(jax.random.fold_in(key, 7), (b, hkv, tk, d),
+                          jnp.bfloat16)
+    qp = jax.random.randint(jax.random.fold_in(key, 8), (b, tq), 100, 900)
+    kp = jax.random.randint(jax.random.fold_in(key, 9), (b, tk), 100, 900)
+    o_ref, lse_ref = jax.jit(lambda q, k, v: _block_attn_xla(
+        q, k, v, qp, kp, 1.0 / np.sqrt(d)))(q, k, v)
+    check("block kernel o",
+          lambda: jax.jit(lambda q, k, v: block_attention(
+              q, k, v, qp, kp))(q, k, v)[0], o_ref, 3e-2)
+    alive = lse_ref > -1e29
+    # the jit program is cached from the 'o' check, so this secs is the
+    # cached-exec cost — still the real kernel, not a trivial where()
+    check("block kernel lse",
+          lambda: jnp.where(alive, jax.jit(lambda q, k, v: block_attention(
+              q, k, v, qp, kp))(q, k, v)[1], 0.0),
+          jnp.where(alive, lse_ref, 0.0), 3e-2)
+
+    # --- positional block kernel BWD (vjp through the custom_vjp), compiled
+    def blk_loss(fn):
+        def f(q, k, v):
+            o, lse = fn(q, k, v)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        return f
+
+    g_ref = jax.jit(jax.grad(blk_loss(lambda q, k, v: _block_attn_xla(
+        q, k, v, qp, kp, 1.0 / np.sqrt(d))), argnums=(0, 1, 2)))(q, k, v)
+    t0 = time.time()
+    g_krn = jax.block_until_ready(
+        jax.jit(jax.grad(blk_loss(lambda q, k, v: block_attention(
+            q, k, v, qp, kp)), argnums=(0, 1, 2)))(q, k, v))
+    bwd_secs = time.time() - t0  # one compile+run for all three components
+    for n_, ref_g, got_g in zip("qkv", g_ref, g_krn):
+        atol = 3e-1 * max(1.0, float(jnp.max(jnp.abs(ref_g))))
+        err = float(jnp.max(jnp.abs(got_g.astype(jnp.float32)
+                                    - ref_g.astype(jnp.float32))))
+        passed = err <= atol
+        RESULTS.append({"name": f"block kernel d{n_}", "err": err,
+                        "atol": atol, "ok": passed,
+                        "secs": round(bwd_secs, 2)})
+        print(f"{'PASS' if passed else 'FAIL'} block kernel d{n_}: "
+              f"max err {err:.2e} (atol {atol:.2e})", flush=True)
+
+    ok = all(r["ok"] for r in RESULTS)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            # top-level key is "all_ok", NOT "ok": the session scripts gate
+            # on grep '"all_ok": true' and each per-check record also has an
+            # "ok" field — a partially-failing run must not look complete
+            json.dump({"device": jax.devices()[0].device_kind,
+                       "all_ok": ok, "checks": RESULTS}, f, indent=1)
+        print(f"wrote {args.out}")
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

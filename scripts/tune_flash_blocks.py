@@ -110,12 +110,16 @@ def sweep_shape(name, b, h, hkv, t, d, blocks, iters):
     return best_fwd, bwd_results[0] if bwd_results else None
 
 
-def main():
+def parse_args(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="fewer block combos / iters")
     ap.add_argument("--iters", type=int, default=20)
-    args = ap.parse_args()
+    return ap.parse_args(argv)
+
+
+def main():
+    args = parse_args()
 
     # Guarded probe (a hung PJRT init — the documented tunnel-outage mode —
     # would otherwise block this script forever; see bench._discover_backend)

@@ -28,5 +28,79 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session", autouse=True)
 def _check_devices():
     assert jax.device_count() >= 8, (
-        f"expected 8 virtual CPU devices, got {jax.device_count()}")
+        f"expected 8 virtual CPU devices, got {jax.device_count()}"
+    )
     yield
+
+
+# --- the `core` lane (VERDICT r4 #7: default loop < 5 min on a 1-core box)
+#
+# One curated representative per parallelism axis / feature, selected from
+# measured durations (the full "not slow" lane is ~32 min on the build box;
+# this list sums to ~4 min including session setup). Subprocess-harness and
+# sweep files (multihost/preemption/wide-mesh/e2e/bench) are deliberately
+# NOT represented — they live in the slow/fast lanes. Maintained centrally
+# here instead of per-file markers so the budget is auditable in one place.
+# An entry is either a whole file ("test_x.py": None) or a list of test-name
+# prefixes (parametrized ids match by prefix).
+CORE_LANE = {
+    # foundations: comm ops + parallel layers + preflight (always run whole)
+    "test_collectives.py": None,
+    "test_parallel_layers.py": None,
+    "test_staged_session.py": None,
+    "test_interop_ckpt.py": None,
+    "test_optim.py": None,
+    "test_prefetch.py": None,
+    "test_native_data.py": None,
+    # one representative per axis/feature
+    "test_transformer_equivalence.py": [
+        "test_loss_and_grads_match[1-4-vocab_parallel]",
+        "test_forward_logits_match[2-4]",
+    ],
+    "test_pipeline.py": ["test_loss_logits_grads_match_single_device[pp2-"],
+    "test_moe.py": ["test_model_loss_logits_grads_match_single_device[ep2-"],
+    "test_ring_attention.py": ["test_ring_forward_matches_dense[2-1]",
+                               "test_grads_match_dense[ring]"],
+    "test_flash_attention.py": ["test_forward_matches_oracle_bf16",
+                                "test_gradients_match_oracle"],
+    "test_gqa.py": ["test_gqa_matches_vanilla[2-1]"],
+    "test_gpt2_model.py": ["test_forward_logits_match_vanilla"],
+    "test_kv_decode.py": ["test_kv_matches_nocache[0-prompt0-1]",
+                          "TestContextParallelDecode::"
+                          "test_cp_decode_matches_cp1[2-1]"],
+    "test_sequence_parallel.py": ["test_model_sp_matches_vanilla[1-1-4]"],
+    "test_zero1.py": ["test_moments_are_dp_sharded"],
+    "test_multi_step.py": ["test_cli_steps_per_dispatch_matches"],
+    "test_grad_accum.py": ["test_accum_matches_concatenated_batch[1-1]"],
+    "test_checkpoint.py": ["test_save_load_roundtrip"],
+    "test_cli_help.py": ["test_help_renders[target0]"],
+    "test_run_step.py": ["test_failure_records_real_rc_and_stderr_tail"],
+    "test_data_pipeline.py": ["test_collate_semantics",
+                              "test_token_json_schema",
+                              "test_reference_shipped_tokenizer_loads"],
+}
+
+
+def _core_match(name: str, pattern: str) -> bool:
+    """Exact test id, or a prefix that ends at a parametrize bracket / a
+    partial param id (pattern ending in '[', '-' or ':'). A bare function
+    name must NOT prefix-match longer siblings (test_x must not pull in
+    test_x_multiblock) — that would silently grow the audited budget."""
+    if name == pattern:
+        return True
+    if pattern.endswith(("[", "-", ":")):
+        return name.startswith(pattern)
+    return name.startswith(pattern + "[")
+
+
+def pytest_collection_modifyitems(config, items):
+    core = pytest.mark.core
+    for item in items:
+        fname = os.path.basename(str(item.fspath))
+        sel = CORE_LANE.get(fname, False)
+        if sel is None:
+            item.add_marker(core)
+        elif sel:
+            name = item.nodeid.split("::", 1)[1] if "::" in item.nodeid else ""
+            if any(_core_match(name, p) for p in sel):
+                item.add_marker(core)

@@ -130,6 +130,57 @@ def test_multihost_cli_resume_broadcast(tokens_json, tmp_path):
     assert _final_loss(outs[0]) == _final_loss(outs[1])
 
 
+def test_sigterm_to_nonzero_process_shuts_down_both(tokens_json, tmp_path):
+    """ADVICE r4: the shutdown consensus must be any-of, not process-0-only.
+    SIGTERM delivered ONLY to process 1 mid-run: both processes must agree,
+    checkpoint, and exit 0 — under the old broadcast-of-process-0's-flag
+    design process 1's signal was silently dropped and the run trained to
+    completion without a shutdown checkpoint."""
+    import signal
+    import threading
+
+    port = _free_port()
+    mh = ["--coordinator", f"localhost:{port}", "--num_processes", "2"]
+    procs = [subprocess.Popen(
+        _train_cmd(tokens_json, tmp_path / "sig", 100000,
+                   extra=("--log_interval", "1", *mh,
+                          "--process_id", str(pid))),
+        env=_env(4), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, bufsize=1) for pid in (0, 1)]
+    outs = [[], []]
+    seen_step = [threading.Event(), threading.Event()]
+
+    def pump(i):
+        for line in procs[i].stdout:
+            outs[i].append(line)
+            if line.startswith("step "):
+                seen_step[i].set()
+
+    threads = [threading.Thread(target=pump, args=(i,), daemon=True)
+               for i in (0, 1)]
+    for t in threads:
+        t.start()
+    try:
+        for i in (0, 1):
+            assert seen_step[i].wait(timeout=600), (
+                f"proc {i} produced no step:\n" + "".join(outs[i]))
+        procs[1].send_signal(signal.SIGTERM)  # ONLY the non-zero process
+        for i in (0, 1):
+            assert procs[i].wait(timeout=300) == 0, "".join(outs[i])
+    finally:
+        for p in procs:
+            p.kill()
+    for t in threads:
+        t.join(timeout=10)
+    for i in (0, 1):
+        assert "shutdown requested: checkpointed at step" in "".join(outs[i]), (
+            f"proc {i}:\n" + "".join(outs[i]))
+    # the shutdown checkpoint exists (process 0 writes)
+    ckpts = [f for f in os.listdir(tmp_path / "sig")
+             if f.startswith("tprank-")]
+    assert ckpts, os.listdir(tmp_path / "sig")
+
+
 def test_multihost_eval_matches_single_process(tmp_path):
     """evaluate.py across two processes: same val-loss sweep and decodes as
     the single-process run (checkpoints broadcast from process 0, doc-mean
