@@ -104,6 +104,22 @@ bench_line gpt2-124mdecode 1200 --model gpt2-124m --decode --batch 4
 bench_line gpt2-124mrematfalse 1200 --model gpt2-124m --remat false
 
 # ---- 4. extras ---------------------------------------------------------
+# jax.profiler trace of the 45M config (VERDICT r4 #3: where do the step
+# milliseconds go — the trace complements bench --breakdown's numbers).
+# 24 steps = 3 dispatches at spd8; ProfilerTrace covers steps 3..3+8.
+# guard: the trace lands at logs/profile/plugins (single-process; ProfilerTrace
+# appends 'profile', jax.profiler adds 'plugins') — match that exact depth
+if ! ls -d "$R"/ckpt_profile/logs/profile/plugins >/dev/null 2>&1; then
+  python scripts/run_step.py --manifest "$M" --name profile_trace \
+    --timeout 1200 --grace 90 -- \
+    python -m distributed_pytorch_from_scratch_tpu.train \
+      --data_path "$TOKENS" --save_dir "$R/ckpt_profile" \
+      --bf16 --batch_size 32 --maxlen 512 \
+      --max_steps 24 --warmup_steps 8 --lr 3e-4 \
+      --steps_per_dispatch 8 --remat dots --profile_steps 8 \
+      --log_interval 8 --save_interval 100000 \
+      2>> "$R/session.log" | tail -10
+fi
 if [ ! -s "$R/tune_blocks.log" ] || ! grep -q "BEST" "$R/tune_blocks.log"; then
   python scripts/run_step.py --manifest "$M" --name block_sweep \
       --timeout 2400 --tee "$R/tune_blocks.log" -- \

@@ -7,6 +7,7 @@
 #     45mbreakdown 45mt8k 45m-moe8 45mremattrue gpt2-124mdecode
 #     gpt2-124mrematfalse)
 #   - tune_blocks.log with BEST, train_packed.log finished
+#   - ckpt_profile/logs/profile/plugins (jax.profiler trace captured)
 # Probes the tunnel under timeout (a down tunnel HANGS PJRT init, never
 # errors); on tunnel-up launches the idempotent run_experiment.sh.
 # Time-aware standdown: the driver runs its own bench at round end
@@ -29,6 +30,7 @@ complete() {
   grep -q "training finished" "$R/train_packed.log" 2>/dev/null || return 1
   grep -q "val loss" "$R/eval.log" 2>/dev/null || return 1
   grep -q "BEST" "$R/tune_blocks.log" 2>/dev/null || return 1
+  ls -d "$R"/ckpt_profile/logs/profile/plugins >/dev/null 2>&1 || return 1
   return 0
 }
 

@@ -79,7 +79,9 @@ def extract_commands(path):
             toks = shlex.split(line)
         except ValueError:
             continue
-        # find a python token that starts a command
+        # find EVERY python command on the line (a `summarize && refresh`
+        # chain stages two commands; stopping at the first would leave the
+        # second unvalidated)
         while "python" in toks:
             i = toks.index("python")
             toks = toks[i:]
@@ -96,7 +98,8 @@ def extract_commands(path):
                 break
             if len(argv) >= 2:
                 cmds.append((lineno, argv))
-            break
+            # resume scanning past this command for a chained `&& python ...`
+            toks = toks[max(len(argv), 1):]
     # drop function-template lines (contain unexpanded "$@")
     return [(ln, argv) for ln, argv in cmds
             if not any("$" in a for a in argv)]
