@@ -21,12 +21,7 @@ cd /root/repo
 R=runs/r5
 M=$R/session_manifest.jsonl
 mkdir -p "$R"
-step() { # step NAME TIMEOUT cmd...
-  local name=$1 to=$2; shift 2
-  echo "=== $name $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
-  python scripts/run_step.py --manifest "$M" --name "$name" --timeout "$to" \
-      -- "$@" 2>> "$R/session.log"
-}
+. "$R/session_lib.sh" || { echo "session_lib.sh missing" >&2; exit 96; }  # step() + bench_line()
 
 step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu', d; print('devices:', d)" \
   || exit 17
@@ -74,25 +69,6 @@ if grep -q "training finished" "$R/train.log" 2>/dev/null \
 fi
 
 # ---- 3. bench lines (value order; fixed t=8k flags) --------------------
-bench_line() { # bench_line TAG TIMEOUT args...
-  local tag=$1 to=$2; shift 2
-  # an error artifact (tunnel dropped mid-line) must not satisfy the guard
-  if grep -q '"error"' "$R/bench_${tag}.json" 2>/dev/null; then
-    rm -f "$R/bench_${tag}.json"
-  fi
-  if [ ! -s "$R/bench_${tag}.json" ]; then
-    echo "=== bench $tag $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
-    python scripts/run_step.py --manifest "$M" --name "bench_${tag}" \
-        --timeout "$to" -- python bench.py "$@" \
-        > "$R/bench_${tag}.json" 2>> "$R/session.log"
-    if [ $? -ne 0 ]; then
-      rm -f "$R/bench_${tag}.json"
-    else
-      cat "$R/bench_${tag}.json" | tee -a "$R/session.log"
-    fi
-  fi
-}
-
 bench_line 45mrematfalse   1200 --model 45m --remat false
 bench_line 45mdecode       1200 --model 45m --decode
 bench_line 45mspd16        1200 --model 45m --remat false --steps_per_dispatch 16

@@ -9,28 +9,23 @@ cd /root/repo
 R=runs/r5
 M=$R/session_manifest.jsonl
 mkdir -p "$R"
+. "$R/session_lib.sh" || { echo "session_lib.sh missing" >&2; exit 96; }  # step() + bench_line()
 echo "=== PRIORITY pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
-python scripts/run_step.py --manifest "$M" --name probe --timeout 120 -- \
-  python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu', d" \
-  2>> "$R/session.log" || exit 17
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu', d" \
+  || exit 17
 
 if ! grep -q '"all_ok": true' "$R/kernel_checks.json" 2>/dev/null; then
-  python scripts/run_step.py --manifest "$M" --name kernel_checks \
-    --timeout 600 -- \
-    python scripts/tpu_checks.py --out "$R/kernel_checks.json" \
-    2>> "$R/session.log" | tee -a "$R/session.log"
+  step kernel_checks 600 python scripts/tpu_checks.py --out "$R/kernel_checks.json" \
+      | tee -a "$R/session.log"
 fi
 
 TOKENS=/tmp/corpus_tokens.json
 if [ ! -s "$R/tokenizer.json" ]; then cp runs/r4/tokenizer.json "$R/tokenizer.json"; fi
 if [ ! -s "$TOKENS" ]; then
-  python scripts/run_step.py --manifest "$M" --name corpus --timeout 1200 -- \
-    python scripts/make_image_corpus.py /tmp/corpus_texts.json \
-    --root /opt/venv/lib/python3.12/site-packages 2>> "$R/session.log"
-  python scripts/run_step.py --manifest "$M" --name tokenize --timeout 1200 -- \
-    python -m distributed_pytorch_from_scratch_tpu.data.tokenizer encode \
-    -i /tmp/corpus_texts.json -o "$TOKENS" -t "$R/tokenizer.json" \
-    2>> "$R/session.log"
+  step corpus 1200 python scripts/make_image_corpus.py /tmp/corpus_texts.json \
+      --root /opt/venv/lib/python3.12/site-packages
+  step tokenize 1200 python -m distributed_pytorch_from_scratch_tpu.data.tokenizer encode \
+      -i /tmp/corpus_texts.json -o "$TOKENS" -t "$R/tokenizer.json"
 fi
 
 # short training slice: --resume + save_interval 250 means even a 6-minute
@@ -46,24 +41,6 @@ if ! grep -q "training finished" "$R/train.log" 2>/dev/null; then
       --log_interval 100 --save_interval 250 --reserve_last_n_ckpts 20 \
       --resume 2>> "$R/session.log" | tail -20
 fi
-
-bench_line() { # bench_line TAG TIMEOUT args...   (same helper as run_experiment.sh)
-  local tag=$1 to=$2; shift 2
-  if grep -q '"error"' "$R/bench_${tag}.json" 2>/dev/null; then
-    rm -f "$R/bench_${tag}.json"
-  fi
-  if [ ! -s "$R/bench_${tag}.json" ]; then
-    echo "=== bench $tag (priority) ===" | tee -a "$R/session.log"
-    python scripts/run_step.py --manifest "$M" --name "bench_${tag}" \
-        --timeout "$to" -- python bench.py "$@" \
-        > "$R/bench_${tag}.json" 2>> "$R/session.log"
-    if [ $? -ne 0 ]; then
-      rm -f "$R/bench_${tag}.json"
-    else
-      cat "$R/bench_${tag}.json" | tee -a "$R/session.log"
-    fi
-  fi
-}
 
 bench_line 45mrematfalse 600 --model 45m --remat false
 bench_line 45mdecode     600 --model 45m --decode

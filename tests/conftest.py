@@ -75,6 +75,8 @@ CORE_LANE = {
     "test_checkpoint.py": ["test_save_load_roundtrip"],
     "test_cli_help.py": ["test_help_renders[target0]"],
     "test_run_step.py": ["test_failure_records_real_rc_and_stderr_tail"],
+    "test_session_shell.py": [
+        "test_bench_line_failure_removes_artifact_and_records_rc"],
     "test_data_pipeline.py": ["test_collate_semantics",
                               "test_token_json_schema",
                               "test_reference_shipped_tokenizer_loads"],
