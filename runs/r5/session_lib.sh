@@ -7,6 +7,13 @@
 # Requires: $R (runs dir), $M (manifest path) set by the sourcing script;
 # `set -o pipefail` recommended (step's tee must not mask the rc).
 
+# Deadline protection (the driver benches the single-tenant chip at round
+# end) lives in scripts/run_step.py::past_deadline — the one chokepoint
+# every step passes through. Past SESSION_DEADLINE (YYYYmmddHHMM UTC,
+# exported by the watcher) run_step refuses to start the child (rc 18,
+# recorded in the manifest) so the chip stays free; no per-call-site guard
+# needed here.
+
 step() { # step NAME TIMEOUT cmd...   -> real rc via scripts/run_step.py
   local name=$1 to=$2; shift 2
   echo "=== $name $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

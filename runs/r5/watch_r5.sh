@@ -15,6 +15,8 @@
 # priority passes until 04:10, then exit.
 set -u
 R=/root/repo/runs/r5
+# hard cutoff: no session step STARTS after this (driver bench window)
+export SESSION_DEADLINE=202608010415
 LOG=/tmp/tpu_status_r5.txt
 
 complete() {

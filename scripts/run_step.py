@@ -81,9 +81,40 @@ def _pump(pipe, sink_path, our_stream, done):
     done.set()
 
 
+def past_deadline():
+    """SESSION_DEADLINE (YYYYmmddHHMM, UTC) guards the driver's
+    end-of-round bench window on the single-tenant chip: past it, no step
+    may START (in-flight steps finish under their own timeouts). Checked
+    here — the one chokepoint every staged step passes through — rather
+    than in each shell call site. A malformed value fails CLOSED: the
+    guard's whole purpose is protecting that window."""
+    raw = os.environ.get("SESSION_DEADLINE")
+    if raw is None:
+        return False
+    try:
+        deadline = int(raw)
+    except ValueError:
+        print(f"run_step: malformed SESSION_DEADLINE {raw!r} — failing "
+              f"closed (refusing to start)", file=sys.stderr)
+        return True
+    return int(time.strftime("%Y%m%d%H%M", time.gmtime())) >= deadline
+
+
 def run(opts, cmd):
     t0 = time.time()
     timed_out = False
+    if past_deadline():
+        rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "name": opts.name, "cmd": cmd, "rc": 18, "secs": 0.0,
+               "timed_out": False, "deadline": True,
+               "stderr_tail": "SESSION_DEADLINE passed; step not started"}
+        os.makedirs(os.path.dirname(os.path.abspath(opts.manifest)),
+                    exist_ok=True)
+        with open(opts.manifest, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        print(f"run_step[{opts.name}]: SESSION_DEADLINE passed — not "
+              f"starting", file=sys.stderr)
+        return 18
     tail_fd, tail_path = tempfile.mkstemp(prefix="run_step_stderr_")
     os.close(tail_fd)
     try:

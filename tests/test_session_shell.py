@@ -38,6 +38,9 @@ def run_snippet(tmp_path, body, fake_bench=None):
         {body}
         """))
     env = {**os.environ}
+    # hermetic: an operator's exported deadline must not leak into tests
+    # (the deadline tests opt in via an explicit export in their snippet)
+    env.pop("SESSION_DEADLINE", None)
     if fake_bench is not None:
         # shadow `python bench.py ...`: a wrapper `python` that execs the
         # stub when its first arg is bench.py, else the real interpreter
@@ -114,6 +117,45 @@ def test_bench_line_error_payload_is_retried(tmp_path):
     assert r2 == r
     rec = json.loads((r / "bench_t3.json").read_text())
     assert "error" not in rec and rec["value"] == 2  # error line re-ran
+
+
+def test_deadline_stops_new_steps_chip_stays_free(tmp_path):
+    """Past SESSION_DEADLINE run_step (the chokepoint) must refuse to
+    start the child — rc 18, recorded in the manifest, no bench artifact —
+    so a late session can't hold the single-tenant chip into the driver's
+    end-of-round bench window. The script itself continues (cheap no-op
+    guards), which is fine: the chip is never touched."""
+    r, p = run_snippet(
+        tmp_path,
+        'export SESSION_DEADLINE=200001010000\n'  # long past
+        'bench_line t5 30 --model 45m\n',
+        fake_bench='import sys; open("CHIP_TOUCHED", "w"); sys.exit(0)')
+    assert not (r / "bench_t5.json").exists()
+    assert not (REPO and os.path.exists(os.path.join(REPO, "CHIP_TOUCHED")))
+    recs = manifest(r)
+    assert recs and recs[0]["rc"] == 18 and recs[0].get("deadline") is True
+
+
+def test_malformed_deadline_fails_closed(tmp_path):
+    r, p = run_snippet(
+        tmp_path,
+        'export SESSION_DEADLINE="2026-08-01T04:15"\n'  # malformed
+        'step s1 30 python -c "print(1)"\n',
+        fake_bench=None)
+    recs = manifest(r)
+    assert recs and recs[0]["rc"] == 18  # refuses to start, loudly
+    # step() routes run_step's stderr into session.log — the complaint
+    # must be in the session forensics, not lost
+    assert "malformed" in (r / "session.log").read_text()
+
+
+def test_deadline_inert_without_deadline(tmp_path):
+    r, p = run_snippet(
+        tmp_path,
+        'step ok 30 python -c "print(42)"\n',
+        fake_bench=None)
+    assert p.returncode == 0  # unset deadline -> run normally
+    assert manifest(r)[0]["rc"] == 0
 
 
 def test_bench_line_good_artifact_is_idempotent(tmp_path):
