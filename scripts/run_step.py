@@ -90,24 +90,28 @@ def past_deadline():
     guard's whole purpose is protecting that window."""
     raw = os.environ.get("SESSION_DEADLINE")
     if raw is None:
-        return False
+        return None
     try:
         deadline = int(raw)
     except ValueError:
-        print(f"run_step: malformed SESSION_DEADLINE {raw!r} — failing "
-              f"closed (refusing to start)", file=sys.stderr)
-        return True
-    return int(time.strftime("%Y%m%d%H%M", time.gmtime())) >= deadline
+        reason = (f"malformed SESSION_DEADLINE {raw!r} — failing closed "
+                  f"(refusing to start)")
+        print(f"run_step: {reason}", file=sys.stderr)
+        return reason
+    if int(time.strftime("%Y%m%d%H%M", time.gmtime())) >= deadline:
+        return f"SESSION_DEADLINE {raw} passed; step not started"
+    return None
 
 
 def run(opts, cmd):
     t0 = time.time()
     timed_out = False
-    if past_deadline():
+    deadline_reason = past_deadline()
+    if deadline_reason:
         rec = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                "name": opts.name, "cmd": cmd, "rc": 18, "secs": 0.0,
                "timed_out": False, "deadline": True,
-               "stderr_tail": "SESSION_DEADLINE passed; step not started"}
+               "stderr_tail": deadline_reason}
         os.makedirs(os.path.dirname(os.path.abspath(opts.manifest)),
                     exist_ok=True)
         with open(opts.manifest, "a") as f:

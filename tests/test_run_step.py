@@ -24,7 +24,10 @@ def run_wrapper(tmp_path, name, cmd, timeout=None, expect_rc=0):
     if timeout is not None:
         argv += ["--timeout", str(timeout)]
     argv += ["--"] + cmd
-    proc = subprocess.run(argv, capture_output=True, text=True)
+    # hermetic against an operator shell's exported session deadline
+    env = {**os.environ}
+    env.pop("SESSION_DEADLINE", None)
+    proc = subprocess.run(argv, capture_output=True, text=True, env=env)
     assert proc.returncode == expect_rc, proc.stderr
     lines = manifest.read_text().strip().splitlines()
     assert len(lines) == 1

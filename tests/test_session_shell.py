@@ -125,13 +125,14 @@ def test_deadline_stops_new_steps_chip_stays_free(tmp_path):
     so a late session can't hold the single-tenant chip into the driver's
     end-of-round bench window. The script itself continues (cheap no-op
     guards), which is fine: the chip is never touched."""
+    canary = tmp_path / "CHIP_TOUCHED"
     r, p = run_snippet(
         tmp_path,
         'export SESSION_DEADLINE=200001010000\n'  # long past
         'bench_line t5 30 --model 45m\n',
-        fake_bench='import sys; open("CHIP_TOUCHED", "w"); sys.exit(0)')
+        fake_bench=f'import sys; open({str(canary)!r}, "w"); sys.exit(0)')
     assert not (r / "bench_t5.json").exists()
-    assert not (REPO and os.path.exists(os.path.join(REPO, "CHIP_TOUCHED")))
+    assert not canary.exists()  # the child must never have started
     recs = manifest(r)
     assert recs and recs[0]["rc"] == 18 and recs[0].get("deadline") is True
 
@@ -144,6 +145,8 @@ def test_malformed_deadline_fails_closed(tmp_path):
         fake_bench=None)
     recs = manifest(r)
     assert recs and recs[0]["rc"] == 18  # refuses to start, loudly
+    # the manifest must tell the TRUTH (malformed, not "deadline passed")
+    assert "malformed" in recs[0]["stderr_tail"]
     # step() routes run_step's stderr into session.log — the complaint
     # must be in the session forensics, not lost
     assert "malformed" in (r / "session.log").read_text()
