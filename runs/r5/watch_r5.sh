@@ -62,6 +62,8 @@ while true; do
     sleep 120
   else
     echo "$(date -u +%FT%TZ) down" >> "$LOG"
-    sleep 180
+    # r4's only window was ~4 min; a 90s probe + 180s sleep cycle could
+    # sleep through half of one. 60s keeps the down-cycle ~2.5 min.
+    sleep 60
   fi
 done

@@ -116,8 +116,7 @@ def run(opts, cmd):
                     exist_ok=True)
         with open(opts.manifest, "a") as f:
             f.write(json.dumps(rec) + "\n")
-        print(f"run_step[{opts.name}]: SESSION_DEADLINE passed — not "
-              f"starting", file=sys.stderr)
+        print(f"run_step[{opts.name}]: {deadline_reason}", file=sys.stderr)
         return 18
     tail_fd, tail_path = tempfile.mkstemp(prefix="run_step_stderr_")
     os.close(tail_fd)

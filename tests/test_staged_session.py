@@ -216,6 +216,29 @@ def test_staged_paths_exist():
                         f"{script}:{lineno} references missing {tok}")
 
 
+def test_watcher_tag_list_matches_staged_bench_lines():
+    """watch_r5.sh's complete() enumerates the bench artifacts it waits
+    for; run_experiment.sh's bench_line calls produce them. A rename on
+    either side would make the watcher wait forever (or declare victory
+    while a line is missing) — the two lists must be identical, and
+    run_priority.sh's subset must exist in the full session."""
+    text = open(os.path.join(R5, "run_experiment.sh")).read()
+    exp_tags = set(re.findall(r"^bench_line\s+(\S+)", text, re.M))
+    text = open(os.path.join(R5, "run_priority.sh")).read()
+    pri_tags = set(re.findall(r"^bench_line\s+(\S+)", text, re.M))
+    watcher = open(os.path.join(R5, "watch_r5.sh")).read()
+    m = re.search(r"for t in ([^;]+); do", watcher)
+    assert m, "watcher bench-tag loop not found"
+    watch_tags = set(m.group(1).replace("\\", " ").split())
+    assert exp_tags, "no bench_line calls extracted from run_experiment.sh"
+    assert watch_tags == exp_tags, (
+        f"watcher waits for {sorted(watch_tags - exp_tags)} that the "
+        f"session never produces / misses {sorted(exp_tags - watch_tags)}")
+    assert pri_tags <= exp_tags, (
+        f"priority-pass tags not in the full session: "
+        f"{sorted(pri_tags - exp_tags)}")
+
+
 def test_train_and_priority_train_flags_agree():
     """run_priority.sh's training slice must resume the SAME run as
     run_experiment.sh: same save_dir, model shape flags, and optimizer
