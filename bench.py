@@ -8,7 +8,7 @@ reference's experiment scale (batch 32, seqlen 1000, bf16 — `train.py:41`,
 under the bench driver).
 
 Flags cover the other BASELINE.md configs:
-    --model {45m,gpt2-124m,tiny,45m-moe8}   model preset (BASELINE 1/3 + MoE)
+    --model {45m,gpt2-124m,gpt2-355m,tiny,45m-moe8}   model preset
     --remat {true,dots,false}      rematerialisation policy
     --batch N --seqlen N           override the experiment shape
     --dp N --tp N                  mesh axes (world = dp*tp must match chips)
@@ -47,19 +47,22 @@ from distributed_pytorch_from_scratch_tpu.training.train_step import (
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="45m",
-                   choices=["45m", "gpt2-124m", "tiny", "45m-moe8"])
+                   choices=["45m", "gpt2-124m", "gpt2-355m", "tiny", "45m-moe8"])
     p.add_argument("--family", default="llama", choices=["llama", "gpt2"],
                    help="model family; 'gpt2' benches GPT2Transformer "
                         "(LayerNorm/GELU/learned positions/tied head) at "
                         "the chosen preset shape")
     # Default "false": no recompute at all — the fastest config whenever
-    # the activations fit, and the 45m/gpt2-124m bench shapes fit a 16G
-    # chip without remat. The fallback ladder steps down to "dots" (matmul
-    # outputs + flash o/lse residuals saved; the proven 33.7%-MFU config)
-    # and then full remat on OOM, so the artifact exists either way.
-    p.add_argument("--remat", default="false", choices=sorted(REMAT_CHOICES))
+    # the activations fit; the 45m/gpt2-124m bench shapes fit a 16G chip
+    # without remat, gpt2-355m needs "dots" (resolved post-parse). The
+    # fallback ladder steps down to "dots" (matmul outputs + flash o/lse
+    # residuals saved; the proven 33.7%-MFU config) and then full remat on
+    # OOM, so the artifact exists either way.
+    p.add_argument("--remat", default=None, choices=sorted(REMAT_CHOICES),
+                   help="default: false (dots for gpt2-355m)")
     p.add_argument("--batch", type=int, default=None,
-                   help="default: 32 (reference train.py:41), 8 for gpt2-124m")
+                   help="default: 32 (reference train.py:41), 8 for "
+                        "gpt2-124m, 4 for gpt2-355m")
     p.add_argument("--seqlen", type=int, default=None,
                    help="default: model maxlen (1000 for 45m)")
     p.add_argument("--dp", type=int, default=1)
@@ -78,7 +81,9 @@ def parse_args(argv=None):
                         "forward+backward, the full optimizer step, and "
                         "the scanned multi-step program, and report the "
                         "derived bwd/adam/dispatch components (answers "
-                        "'where do the step milliseconds go')")
+                        "'where do the step milliseconds go'). NOTE: no "
+                        "OOM fallback ladder here — pick a fitting "
+                        "--remat/--batch")
     p.add_argument("--decode", action="store_true",
                    help="bench GENERATION throughput instead of training: "
                         "KV-cache batched decode (models/decode.py) vs the "
@@ -89,7 +94,10 @@ def parse_args(argv=None):
                    help="--decode: tokens per prompt")
     p.add_argument("--gen_tokens", type=int, default=128,
                    help="--decode: generation budget per prompt")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.remat is None:
+        args.remat = "dots" if args.model == "gpt2-355m" else "false"
+    return args
 
 
 def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto"):
@@ -106,9 +114,12 @@ def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto"):
 
 
 def default_batch(args) -> int:
-    """b8 for gpt2-124m (the only shape validated to fit 16G without
-    remat), b32 (the reference's experiment batch) otherwise."""
-    return args.batch or (8 if args.model == "gpt2-124m" else 32)
+    """b8 for gpt2-124m (validated to fit 16G without remat), b4 for
+    gpt2-355m (fits WITH remat), b32 (the reference's experiment batch)
+    otherwise."""
+    if args.batch:
+        return args.batch
+    return {"gpt2-124m": 8, "gpt2-355m": 4}.get(args.model, 32)
 
 
 def run_decode_bench(args, mesh, cfg, tp: int) -> None:

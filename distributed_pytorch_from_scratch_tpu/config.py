@@ -123,6 +123,10 @@ MODEL_PRESETS = {
     # the 45m shape with its FFN swapped for 8 routed experts (top-2):
     # ~160M total params, 45m-class active compute per token
     "45m-moe8": ModelConfig(num_experts=8, moe_top_k=2),
+    # GPT-2 Medium shape — 3x the reference's biggest config; params+Adam
+    # state ~4.3 GiB f32, fits the 16 GiB chip with remat at b4xt1024
+    "gpt2-355m": ModelConfig(attn_dim=1024, ffn_dim=4096, num_heads=16,
+                             num_layers=24, vocab_size=50257, maxlen=1024),
 }
 
 

@@ -78,6 +78,7 @@ bench_line 45m-moe8        1800 --model 45m-moe8 --remat dots
 bench_line 45mremattrue    1200 --model 45m --remat true
 bench_line gpt2-124mdecode 1200 --model gpt2-124m --decode --batch 4
 bench_line gpt2-124mrematfalse 1200 --model gpt2-124m --remat false
+bench_line gpt2-355mrematdots  2400 --model gpt2-355m --family gpt2 --remat dots
 
 # ---- 4. extras ---------------------------------------------------------
 # jax.profiler trace of the 45M config (VERDICT r4 #3: where do the step

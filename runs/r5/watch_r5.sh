@@ -3,9 +3,9 @@
 # in this header IS the list complete() checks — keep them in sync):
 #   - kernel_checks.json with "all_ok": true
 #   - train.log with "training finished" and eval.log with "val loss"
-#   - all 9 bench_*.json lines (45mrematfalse 45mdecode 45mspd16
+#   - all 10 bench_*.json lines (45mrematfalse 45mdecode 45mspd16
 #     45mbreakdown 45mt8k 45m-moe8 45mremattrue gpt2-124mdecode
-#     gpt2-124mrematfalse)
+#     gpt2-124mrematfalse gpt2-355mrematdots)
 #   - tune_blocks.log with BEST, train_packed.log finished
 #   - ckpt_profile/logs/profile/plugins (jax.profiler trace captured)
 # Probes the tunnel under timeout (a down tunnel HANGS PJRT init, never
@@ -22,7 +22,7 @@ LOG=/tmp/tpu_status_r5.txt
 complete() {
   grep -q '"all_ok": true' "$R/kernel_checks.json" 2>/dev/null || return 1
   for t in 45mrematfalse 45mdecode 45mspd16 45mbreakdown 45mt8k 45m-moe8 \
-           45mremattrue gpt2-124mdecode gpt2-124mrematfalse; do
+           45mremattrue gpt2-124mdecode gpt2-124mrematfalse gpt2-355mrematdots; do
     [ -s "$R/bench_${t}.json" ] || return 1
     # an error payload (tunnel dropped mid-line) is NOT a measured number —
     # bench_line deletes these before re-running; completion must agree
