@@ -9,11 +9,18 @@ under the bench driver).
 
 Flags cover the other BASELINE.md configs:
     --model {45m,gpt2-124m,gpt2-355m,tiny,45m-moe8}   model preset
+    --family {llama,gpt2}          model family at the preset shape
     --remat {true,dots,false}      rematerialisation policy
+                                   (default false; dots for gpt2-355m)
     --batch N --seqlen N           override the experiment shape
     --dp N --tp N                  mesh axes (world = dp*tp must match chips)
     --steps_per_dispatch N         optimizer steps per device dispatch
                                    (train.py's scanned megabatch mode)
+    --decode                       KV-cache generation throughput instead of
+                                   training (vs_baseline = per-stream speedup
+                                   over reference-semantics recompute)
+    --breakdown                    step-time accounting (H2D/fwd/bwd/adam/
+                                   dispatch components)
 
 vs_baseline: the reference publishes no numbers (BASELINE.md), so the
 driver-assigned north star is used — MFU >= 30% on TPU. vs_baseline is
