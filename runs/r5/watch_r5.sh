@@ -41,6 +41,13 @@ while true; do
     echo "$(date -u +%FT%TZ) session artifacts complete — watcher exiting" >> "$LOG"
     exit 0
   fi
+  # absolute stop even while DOWN: past the priority window nothing can
+  # usefully start, and probing through the driver's bench window (the
+  # chip is single-tenant) is pointless noise
+  if [ "$(date -u +%Y%m%d%H%M)" -ge 202608010410 ]; then
+    echo "$(date -u +%FT%TZ) past 04:10 cutoff — watcher exiting" >> "$LOG"
+    exit 0
+  fi
   # -k 10: a hung PJRT init ignores SIGTERM (the documented outage mode);
   # without the follow-up SIGKILL a wedged probe would hold the
   # single-tenant tunnel forever and starve every later window
