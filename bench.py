@@ -91,6 +91,12 @@ def parse_args(argv=None):
                         "'where do the step milliseconds go'). NOTE: no "
                         "OOM fallback ladder here — pick a fitting "
                         "--remat/--batch")
+    p.add_argument("--introspect", action="store_true",
+                   help="AOT-compile the benched program once more and "
+                        "print its cost analysis to stderr (XLA FLOPs vs "
+                        "the hand-rolled estimate, bytes accessed, peak "
+                        "HBM, per-collective comm bytes — obs/introspect); "
+                        "adds one compile to the bench run")
     p.add_argument("--decode", action="store_true",
                    help="bench GENERATION throughput instead of training: "
                         "KV-cache batched decode (models/decode.py) vs the "
@@ -373,9 +379,14 @@ def main(argv=None):
     tp = args.tp or max(1, n_dev // args.dp)
     mesh = make_mesh(MeshConfig(dp=args.dp, tp=tp))
     cfg = model_preset(args.model, compute_dtype="bfloat16")
-    if args.decode:
-        return run_decode_bench(args, mesh, cfg, tp)
-    if args.breakdown:
+    if args.decode or args.breakdown:
+        if args.introspect:
+            print("bench: --introspect only applies to the default "
+                  "training bench; ignoring it for "
+                  f"--{'decode' if args.decode else 'breakdown'}",
+                  file=sys.stderr)
+        if args.decode:
+            return run_decode_bench(args, mesh, cfg, tp)
         return run_breakdown(args, mesh, cfg, tp)
     ocfg = OptimizerConfig()
     spd = max(1, args.steps_per_dispatch)
@@ -464,6 +475,21 @@ def main(argv=None):
     flops_per_step = model_flops_per_step(
         cfg, B, T, params=params if args.family == "gpt2" else None)
     mfu = flops_per_step / step_s / (chip_peak_flops() * world)
+
+    if args.introspect:
+        from distributed_pytorch_from_scratch_tpu.obs import (
+            analyze_compiled, format_analysis)
+        try:
+            analysis = analyze_compiled(
+                step_fn.lower(params, opt_state, ids, tgt, pos).compile())
+            # per-device SPMD program, x spd scanned steps
+            expected = flops_per_step * spd / world
+            print("bench introspection: "
+                  + format_analysis(analysis, model_flops=expected),
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — diagnostics must not kill
+            print(f"bench introspection unavailable: "
+                  f"{type(e).__name__}: {str(e)[:200]}", file=sys.stderr)
 
     p50 = allreduce_p50_us(mesh, "tp") if tp > 1 else None
 

@@ -57,12 +57,17 @@ class Prefetcher:
     producer polls a stop flag around its bounded puts, so an abandoned
     epoch does not leak a blocked thread. Tracks `wait_time` (seconds the
     CONSUMER spent blocked) so the host-overlap win is measurable.
+
+    `tracer`: optional obs.SpanTracer — each window's collate+stack work
+    records a "prefetch_window" span on the producer thread, so the
+    timeline shows the input pipeline's own track next to the train loop
+    (queue-blocked time is excluded: the span covers source+transform only).
     """
 
     _DONE = object()
 
     def __init__(self, src: Iterable, depth: int = 2,
-                 transform: Optional[Callable] = None):
+                 transform: Optional[Callable] = None, tracer=None):
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self.wait_time = 0.0
@@ -70,9 +75,18 @@ class Prefetcher:
 
         def worker():
             try:
-                for item in src:
+                it = iter(src)
+                while True:
+                    t0 = tracer.now() if tracer is not None else None
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        break
                     if transform is not None:
                         item = transform(item)
+                    if tracer is not None:
+                        tracer.complete("prefetch_window", t0,
+                                        cat="data_prep")
                     self._put_until_stopped(item)
                     if self._stop.is_set():
                         return

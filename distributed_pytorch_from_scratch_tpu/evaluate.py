@@ -33,6 +33,7 @@ from .config import BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MeshConfig
 from .data.dataset import get_dataloader
 from .models.decode import GreedyDecoder
 from .models.transformer import Transformer
+from .obs import SpanTracer
 from .runtime.mesh import batch_feeder, init_multihost, make_mesh
 from .training.checkpoint import list_checkpoints, load_checkpoint
 from .training.metrics import MetricsWriter
@@ -381,41 +382,56 @@ def evaluate(args: argparse.Namespace) -> dict:
 
     writer = MetricsWriter(os.path.join(args.ckpt_dir, "val")) if is_main \
         else None
+    # eval gets its own host timeline (same Chrome-trace format as train):
+    # per-checkpoint restore + val sweep + decode, proc 0 only
+    tracer = SpanTracer(os.path.join(args.ckpt_dir, "val"), enabled=is_main)
     report_path = os.path.join(args.ckpt_dir, "val", "val.txt")
     results = {}
     params = None
-    with open(report_path if is_main else os.devnull, "a") as f:
-        f.write("Ckpt -> Validation loss\n")
-        for it in ckpt_iters:
-            params = jax.device_put(load_params(it), model.shardings(mesh))
-            avg = calc_val_loss(loss_fn, params, dataloader,
-                                args.batch_size, feed=feed, collect=collect)
-            if is_main:
-                print(f"iter {it}: val loss {avg:.4f}")
-                f.write(f"{paths.get(it, f'iter-{it}')} -> {avg:.4f}\n")
-                writer.scalar("val/loss", avg, it)
-            results[it] = avg
+    try:
+        with open(report_path if is_main else os.devnull, "a") as f:
+            f.write("Ckpt -> Validation loss\n")
+            for it in ckpt_iters:
+                with tracer.span("restore", cat="checkpoint", ckpt=it):
+                    params = jax.device_put(load_params(it),
+                                            model.shardings(mesh))
+                with tracer.span("val_loss", cat="eval", ckpt=it):
+                    avg = calc_val_loss(loss_fn, params, dataloader,
+                                        args.batch_size, feed=feed,
+                                        collect=collect)
+                if is_main:
+                    print(f"iter {it}: val loss {avg:.4f}")
+                    f.write(f"{paths.get(it, f'iter-{it}')} -> {avg:.4f}\n")
+                    writer.scalar("val/loss", avg, it)
+                results[it] = avg
 
-    # params now holds the NEWEST checkpoint (the reference meant to do this
-    # but indexed a string, test.py:124)
-    tokenizer = HFTokenizer.from_file(args.tokenizer_path)
-    bos_id, eos_id = dataloader.dataset.bos, dataloader.dataset.eos
-    assert tokenizer.token_to_id(BOS_TOKEN) == bos_id
-    assert tokenizer.token_to_id(EOS_TOKEN) == eos_id
-    decoded = greedy_decode(model, mesh, params, tokenizer, DECODE_PROMPTS,
-                            bos_id, eos_id, args.max_decode_len,
-                            use_kv_cache=not args.no_kv_cache,
-                            temperature=args.temperature,
-                            top_k=args.decode_top_k,
-                            top_p=args.decode_top_p, seed=args.random_seed)
-    with open(report_path if is_main else os.devnull, "a") as f:
-        f.write("\n\nInput texts -> Decoded texts\n")
-        for prompt, completion in decoded:
-            if is_main:
-                print(f"{prompt} -> {completion}")
-            f.write(f"{prompt} -> {completion}\n")
-    if writer is not None:
-        writer.close()
+        # params now holds the NEWEST checkpoint (the reference meant to do this
+        # but indexed a string, test.py:124)
+        tokenizer = HFTokenizer.from_file(args.tokenizer_path)
+        bos_id, eos_id = dataloader.dataset.bos, dataloader.dataset.eos
+        assert tokenizer.token_to_id(BOS_TOKEN) == bos_id
+        assert tokenizer.token_to_id(EOS_TOKEN) == eos_id
+        with tracer.span("decode", cat="eval", prompts=len(DECODE_PROMPTS)):
+            decoded = greedy_decode(model, mesh, params, tokenizer,
+                                    DECODE_PROMPTS,
+                                    bos_id, eos_id, args.max_decode_len,
+                                    use_kv_cache=not args.no_kv_cache,
+                                    temperature=args.temperature,
+                                    top_k=args.decode_top_k,
+                                    top_p=args.decode_top_p,
+                                    seed=args.random_seed)
+        with open(report_path if is_main else os.devnull, "a") as f:
+            f.write("\n\nInput texts -> Decoded texts\n")
+            for prompt, completion in decoded:
+                if is_main:
+                    print(f"{prompt} -> {completion}")
+                f.write(f"{prompt} -> {completion}\n")
+    finally:
+        # a failed sweep/decode still finalises trace.json (the timeline of
+        # a PARTIAL eval is the one you actually want) and closes handles
+        tracer.close()
+        if writer is not None:
+            writer.close()
     return {"val_losses": results, "decoded": decoded}
 
 

@@ -1,0 +1,19 @@
+"""Observability: step-timeline tracing, goodput accounting, compiled-
+program introspection, a training-health sentinel, and a hang watchdog.
+
+See docs/OBSERVABILITY.md for the operator's view (trace format, goodput
+buckets, sentinel thresholds).
+"""
+
+from .goodput import BUCKETS, GoodputMeter
+from .introspect import analyze_compiled, format_analysis, parse_collectives
+from .observer import TrainObserver
+from .sentinel import HealthSentinel, TrainingHealthError
+from .trace import SpanTracer
+from .watchdog import HangWatchdog
+
+__all__ = [
+    "BUCKETS", "GoodputMeter", "HangWatchdog", "HealthSentinel",
+    "SpanTracer", "TrainObserver", "TrainingHealthError",
+    "analyze_compiled", "format_analysis", "parse_collectives",
+]

@@ -1,0 +1,126 @@
+"""TrainObserver: the one handle the training loop holds on the whole
+observability stack — tracer + goodput meter + health sentinel + hang
+watchdog — so instrumenting a call site is a single
+`with observer.span("bucket"):` line.
+
+One span call feeds three consumers at once: the Chrome-trace timeline
+(where exactly did the wall clock go), the goodput buckets (aggregate
+accounting, guaranteed consistent with the timeline because they share the
+measurement), and the watchdog heartbeat (any activity is liveness). The
+sentinel rides the loop's existing logging-interval D2H via
+`check_health()`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from .goodput import GoodputMeter
+from .sentinel import HealthSentinel
+from .trace import SpanTracer
+from .watchdog import HangWatchdog
+
+
+class TrainObserver:
+    def __init__(self, log_dir: str, writer=None, trace: bool = True,
+                 watchdog_secs: float = 0.0, sentinel: bool = True,
+                 spike_factor: float = 3.0, halt_on_nonfinite: bool = True,
+                 process_index: int = 0):
+        self.writer = writer
+        self.tracer = SpanTracer(log_dir, enabled=trace, pid=process_index,
+                                 process_name=f"train-p{process_index}")
+        self.goodput = GoodputMeter()
+        self.sentinel = (HealthSentinel(
+            log_dir, spike_factor=spike_factor,
+            halt_on_nonfinite=halt_on_nonfinite,
+            writer=writer, tracer=self.tracer) if sentinel else None)
+        self.watchdog = (HangWatchdog(
+            watchdog_secs, process_index=process_index, writer=writer,
+            tracer=self.tracer) if watchdog_secs > 0 else None)
+        self._closed = False
+        self._local = threading.local()
+
+    @contextmanager
+    def span(self, bucket: str, name: Optional[str] = None, **args):
+        """Trace a span AND attribute its wall time to a goodput bucket.
+        `bucket` is one of obs.goodput.BUCKETS (or any new category);
+        `name` defaults to the bucket for the timeline label. Nested spans
+        all appear on the timeline, but only the OUTERMOST one accounts
+        goodput time (else nesting would double-count the wall clock and
+        the buckets would sum past 100%)."""
+        if self.watchdog is not None:
+            self.watchdog.beat(phase=name or bucket)
+        depth = getattr(self._local, "depth", 0)
+        self._local.depth = depth + 1
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span(name or bucket, cat=bucket, **args):
+                yield
+        finally:
+            self._local.depth = depth
+            if depth == 0:
+                self.goodput.account(bucket, time.perf_counter() - t0)
+            if self.watchdog is not None:
+                # beat on exit too: after a long compile/checkpoint the
+                # stall clock restarts from completion, and the watchdog's
+                # "recovered" line marks the moment it finished
+                self.watchdog.beat(phase=f"{name or bucket}:done")
+
+    def instant(self, name: str, **args) -> None:
+        self.tracer.instant(name, **args)
+
+    def heartbeat(self, step: int, tokens: int = 0, steps: int = 1) -> None:
+        """Called once per completed dispatch: liveness + progress."""
+        self.goodput.add_progress(tokens, steps)
+        if self.watchdog is not None:
+            self.watchdog.beat(step=step)
+
+    def check_health(self, step: int, loss: float,
+                     grad_norm: Optional[float] = None) -> None:
+        """Raises TrainingHealthError on non-finite values (sentinel off ->
+        no-op)."""
+        if self.sentinel is not None:
+            self.sentinel.check(step, loss, grad_norm=grad_norm)
+
+    def report_compiled(self, analysis: dict, model_flops: float,
+                        steps_in_program: int = 1,
+                        expected_flops: Optional[float] = None,
+                        step: int = 0) -> None:
+        """Log the introspection record (obs.introspect.analyze_compiled)
+        to metrics + trace; the caller prints the human line.
+        `expected_flops` = the hand-rolled estimate scaled to THIS program
+        (x steps per dispatch, / world size for SPMD per-device HLO)."""
+        if self.writer is not None:
+            self.writer.event(
+                "cost_analysis", step=step,
+                flops=analysis.get("flops"),
+                bytes_accessed=analysis.get("bytes_accessed"),
+                peak_hbm_bytes=analysis.get("peak_hbm_bytes"),
+                collectives=analysis.get("collectives"),
+                comm_bytes=analysis.get("comm_bytes"),
+                model_flops_per_step=model_flops,
+                steps_in_program=steps_in_program,
+                expected_program_flops=expected_flops)
+        self.tracer.instant("cost_analysis", flops=analysis.get("flops"))
+
+    def close(self, print_summary: bool = True) -> Optional[dict]:
+        """Stop the watchdog, write trace.json, log + return the goodput
+        summary. Idempotent (later calls return None)."""
+        if self._closed:
+            return None
+        self._closed = True
+        if self.watchdog is not None:
+            self.watchdog.close()
+        summary = self.goodput.summary()
+        if self.writer is not None:
+            self.writer.event("goodput_summary", **summary)
+        if print_summary:
+            print(GoodputMeter.format_summary(summary))
+        path = self.tracer.close()
+        if path is not None and print_summary:
+            print(f"host timeline trace written to {path} "
+                  f"(open in https://ui.perfetto.dev)")
+        return summary

@@ -1,0 +1,105 @@
+"""Training-health sentinel: catch divergence the moment it is visible.
+
+The sentinel is fed host floats at the existing logging-interval D2H sync
+(`train.py` already pulls the accumulated loss there), so it adds ZERO device
+syncs to the hot path — the steps between checks dispatch fully async, and a
+blow-up is detected at most one log interval after it happens.
+
+Two behaviours:
+  * non-finite loss or grad norm  -> write a JSON state dump (history, EMA,
+    config) and raise `TrainingHealthError`, halting the run. Training on
+    NaN params silently corrupts every later checkpoint; dying loudly with
+    forensics is strictly better.
+  * loss spike (> spike_factor x EMA) -> log a `sentinel/loss_spike` event
+    and keep going (spikes self-heal often enough that halting is wrong,
+    but they are the leading indicator worth a timeline mark).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Optional
+
+
+class TrainingHealthError(RuntimeError):
+    """Raised by HealthSentinel on a non-finite loss/grad-norm; carries the
+    path of the state dump written just before the halt."""
+
+    def __init__(self, message: str, dump_path: Optional[str] = None):
+        super().__init__(message)
+        self.dump_path = dump_path
+
+
+class HealthSentinel:
+    def __init__(self, dump_dir: str, spike_factor: float = 3.0,
+                 ema_decay: float = 0.9, halt_on_nonfinite: bool = True,
+                 history: int = 64, writer=None, tracer=None):
+        self.dump_dir = dump_dir
+        self.spike_factor = spike_factor
+        self.ema_decay = ema_decay
+        self.halt_on_nonfinite = halt_on_nonfinite
+        self.writer = writer
+        self.tracer = tracer
+        self.ema: Optional[float] = None
+        self.spikes = 0
+        self._history = deque(maxlen=history)
+
+    def check(self, step: int, loss: float, grad_norm: Optional[float] = None
+              ) -> None:
+        """One health check on host floats. Raises TrainingHealthError on a
+        non-finite value (after dumping state); records spikes otherwise."""
+        loss = float(loss)
+        gn = None if grad_norm is None else float(grad_norm)
+        self._history.append({"step": int(step), "loss": loss,
+                              "grad_norm": gn, "ts": time.time()})
+        bad = []
+        if not math.isfinite(loss):
+            bad.append(f"loss={loss}")
+        if gn is not None and not math.isfinite(gn):
+            bad.append(f"grad_norm={gn}")
+        if bad:
+            reason = f"non-finite at step {step}: {', '.join(bad)}"
+            path = self.dump(step, reason)
+            self._event("sentinel/nonfinite", step, reason=reason, dump=path)
+            if self.halt_on_nonfinite:
+                raise TrainingHealthError(
+                    f"training halted — {reason} (state dump: {path}); "
+                    f"rerun with --debug_nans to trap the originating op",
+                    dump_path=path)
+            return
+        if (self.ema is not None and self.spike_factor > 0
+                and loss > self.spike_factor * self.ema):
+            self.spikes += 1
+            self._event("sentinel/loss_spike", step, loss=loss, ema=self.ema,
+                        factor=loss / max(self.ema, 1e-12))
+            print(f"sentinel: loss spike at step {step} — {loss:.4f} vs "
+                  f"EMA {self.ema:.4f} (x{loss / max(self.ema, 1e-12):.1f})")
+        self.ema = (loss if self.ema is None
+                    else self.ema_decay * self.ema
+                    + (1 - self.ema_decay) * loss)
+
+    def dump(self, step: int, reason: str) -> str:
+        """Write the sentinel's view of the run to a JSON file for
+        post-mortem. Deliberately NO checkpoint of the at-halt params: a
+        `tprank-*` file full of NaNs would become `latest_step` and poison
+        the next `--resume`. The post-mortem pair is this file (the WHY)
+        plus the last regular checkpoint (healthy params from at most
+        save_interval steps earlier)."""
+        os.makedirs(self.dump_dir, exist_ok=True)
+        path = os.path.join(self.dump_dir, f"sentinel_dump_step{step}.json")
+        with open(path, "w") as f:
+            json.dump({"reason": reason, "step": int(step), "ema": self.ema,
+                       "spikes": self.spikes, "ts": time.time(),
+                       "history": list(self._history)}, f, indent=1)
+        print(f"sentinel: state dump written to {path}")
+        return path
+
+    def _event(self, tag: str, step: int, **fields) -> None:
+        if self.writer is not None:
+            self.writer.event(tag, step=step, **fields)
+        if self.tracer is not None:
+            self.tracer.instant(tag, step=step, **fields)

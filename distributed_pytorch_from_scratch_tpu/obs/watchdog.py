@@ -1,0 +1,119 @@
+"""Heartbeat/hang watchdog: scream when the training loop stops progressing.
+
+Multi-host hangs are the nastiest failure mode of collective-based training:
+one process misses a collective and every other process blocks inside XLA
+forever, producing no output and no error (the reference has exactly this
+failure surface via NCCL and no watchdog either). The watchdog is a daemon
+thread per process that watches a heartbeat the train loop taps on every
+dispatch and on every span start/end. A phase that legitimately runs longer
+than the timeout (a big model's first compile) still trips the report —
+deliberately: the report names the in-flight phase (`last activity
+'compile'`), and the matching `watchdog/recovered` line when it completes
+distinguishes "slow but alive" from a true hang, which never recovers. It
+keeps shouting at every further timeout window.
+
+Deliberately NO collectives on the watchdog thread: a stalled process
+gathering liveness over the same fabric that is hung would deadlock too.
+Each process reports locally; the per-process `metrics*.jsonl` /
+stdout streams are the cross-host view.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+
+class HangWatchdog:
+    def __init__(self, timeout_s: float, process_index: int = 0,
+                 writer=None, tracer=None,
+                 on_stall: Optional[Callable[[dict], None]] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 poll_s: Optional[float] = None):
+        self.timeout_s = timeout_s
+        self.process_index = process_index
+        self.writer = writer
+        self.tracer = tracer
+        self.on_stall = on_stall
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_beat = clock()
+        self._last_step: Optional[int] = None
+        self._last_phase = "startup"
+        self._stalled = False
+        self._stall_started: Optional[float] = None
+        self.stall_count = 0
+        self._stop = threading.Event()
+        self._poll = poll_s if poll_s is not None else max(
+            min(timeout_s / 4.0, 10.0), 0.05)
+        self._thread = threading.Thread(target=self._watch, daemon=True,
+                                        name="hang-watchdog")
+        self._thread.start()
+
+    def beat(self, step: Optional[int] = None, phase: str = "step") -> None:
+        """Tap the heartbeat. `step` = last COMPLETED step when known;
+        span starts beat with their phase and no step."""
+        recovered = None
+        with self._lock:
+            self._last_beat = self._clock()
+            self._last_phase = phase
+            if step is not None:
+                self._last_step = int(step)
+            if self._stalled:
+                self._stalled = False
+                dur = (self._clock() - self._stall_started
+                       if self._stall_started is not None else None)
+                self._stall_started = None
+                recovered = (dur, self._last_step)
+        # emit/print OUTSIDE the lock: writer/tracer I/O (and any on_stall
+        # callback) must never run while holding it — a callback touching
+        # the watchdog would deadlock the beat path and hang the loop
+        if recovered is not None:
+            dur, last_step = recovered
+            self._emit("watchdog/recovered",
+                       stalled_for=None if dur is None else round(dur, 3))
+            print(f"watchdog[p{self.process_index}]: progress resumed"
+                  + (f" after {dur:.1f}s" if dur is not None else "")
+                  + (f" (step {last_step})"
+                     if last_step is not None else ""))
+
+    def _emit(self, tag: str, **fields) -> None:
+        rec = {"process": self.process_index, "last_step": self._last_step,
+               "last_phase": self._last_phase, **fields}
+        if self.writer is not None:
+            self.writer.event(tag, **rec)
+        if self.tracer is not None:
+            self.tracer.instant(tag, **rec)
+        if self.on_stall is not None and tag == "watchdog/stall":
+            self.on_stall(rec)
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                stalled_for = self._clock() - self._last_beat
+                if stalled_for < self.timeout_s:
+                    continue
+                # re-arm so the next shout comes one full window later;
+                # remember when the stall BEGAN so recovery can report the
+                # true duration across multiple shout windows
+                if not self._stalled:
+                    self._stall_started = self._last_beat
+                self._last_beat = self._clock()
+                self._stalled = True
+                self.stall_count += 1
+                last_step, last_phase = self._last_step, self._last_phase
+            # I/O and the on_stall callback run lock-free (see beat())
+            self._emit("watchdog/stall", stalled_for=round(stalled_for, 3))
+            print(f"WATCHDOG[p{self.process_index}]: no progress for "
+                  f"{stalled_for:.1f}s — last completed step "
+                  f"{last_step}, last activity "
+                  f"'{last_phase}' (may still be executing — a "
+                  f"'recovered' line follows if it finishes). If every "
+                  f"process reports the same step, suspect the input "
+                  f"pipeline; if they differ, a collective is hung.",
+                  flush=True)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)

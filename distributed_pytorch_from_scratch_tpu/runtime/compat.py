@@ -1,0 +1,63 @@
+"""Shims for older jax releases — imported before anything else in the
+package (`__init__.py`).
+
+The codebase targets the current jax API surface (`jax.shard_map`,
+`jax.typeof`, `jax.sharding.AxisType`); some deployment images pin an older
+jax (0.4.x) where those names live elsewhere or do not exist. Each shim is
+applied only when the attribute is missing, so on a current jax this module
+is a no-op. Centralised here instead of per-call-site guards so the rest of
+the code reads as plain current-jax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f=None, *, mesh, in_specs, out_specs, **kw):
+        # the modern kwarg is check_vma; the experimental one was check_rep
+        if "check_vma" in kw:
+            kw.setdefault("check_rep", kw.pop("check_vma"))
+        # The old rep-checker is a static debugging aid with no rules for
+        # primitives current code uses freely inside shard_map (`while`
+        # loops, live-gated `cond` branches, and their transposes — the
+        # transpose-time bails cannot even be caught at the call layer).
+        # Its own error message recommends check_rep=False; values and
+        # gradients are identical without it, only the efficient-psum-
+        # transpose rewrite and the static check are lost. Default it off
+        # on legacy jax; explicit caller values still win.
+        kw.setdefault("check_rep", False)
+        if f is None:
+            return lambda g: _compat_shard_map(g, mesh=mesh,
+                                               in_specs=in_specs,
+                                               out_specs=out_specs, **kw)
+        return _shard_map(f, mesh, in_specs, out_specs, **kw)
+
+    jax.shard_map = _compat_shard_map
+
+if not hasattr(jax, "typeof"):
+    # jax.typeof(x) -> aval; callers only getattr() optional fields (vma),
+    # so the old get_aval is a faithful stand-in
+    jax.typeof = jax.core.get_aval
+
+if not hasattr(jax.lax, "axis_size"):
+    # psum of the constant 1 constant-folds to the axis size without any
+    # communication — the standard pre-axis_size spelling
+    jax.lax.axis_size = lambda axis_name: jax.lax.psum(1, axis_name)
+
+try:  # pallas-TPU params class was renamed TPUCompilerParams -> CompilerParams
+    from jax.experimental.pallas import tpu as _pltpu
+
+    if (not hasattr(_pltpu, "CompilerParams")
+            and hasattr(_pltpu, "TPUCompilerParams")):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except Exception:  # pallas entirely absent: the kernels gate on import
+    pass
+
+if not hasattr(jax.lax, "pvary"):
+    # pvary is a TYPE-level replicated->varying cast for the new vma
+    # system; value-wise it is the identity, and old shard_map's check_rep
+    # rewriter tracks replication itself — identity is the faithful shim
+    jax.lax.pvary = lambda x, axis_name=None, *a, **k: x

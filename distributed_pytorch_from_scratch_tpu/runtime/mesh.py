@@ -59,8 +59,12 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence[jax.Device]] = None) -
         )
     grid = np.asarray(devices[:n]).reshape(cfg.dp, cfg.pp, cfg.cp, cfg.ep,
                                            cfg.tp)
-    return Mesh(grid, AXIS_NAMES,
-                axis_types=(jax.sharding.AxisType.Auto,) * len(AXIS_NAMES))
+    # axis_types landed after jax 0.4.x; Auto is that default anyway, so on
+    # older releases plain Mesh(devices, names) is the same mesh
+    if hasattr(jax.sharding, "AxisType"):
+        return Mesh(grid, AXIS_NAMES,
+                    axis_types=(jax.sharding.AxisType.Auto,) * len(AXIS_NAMES))
+    return Mesh(grid, AXIS_NAMES)
 
 
 def single_device_mesh() -> Mesh:
@@ -105,6 +109,17 @@ def batch_feeder(mesh: Mesh):
             x.shape, NamedSharding(mesh, spec), lambda idx: x[idx])
 
     return feed
+
+
+def process_info() -> "tuple[int, int]":
+    """(process_index, process_count) — safe to call before (or without)
+    `init_multihost`: backendless failures degrade to a single-process view.
+    Shared by MetricsWriter (per-process file tagging) and the obs layer
+    (trace pid, watchdog messages)."""
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
 
 
 def init_multihost(coordinator: Optional[str] = None,

@@ -36,7 +36,9 @@ from .config import (IGNORE_INDEX, MODEL_PRESETS, REMAT_CHOICES, MeshConfig,
 from .data.dataset import get_dataloader
 from .data.prefetch import Prefetcher, stack_window, window_stream
 from .models.transformer import Transformer
-from .runtime.mesh import batch_feeder, init_multihost, make_mesh
+from .obs import TrainObserver, analyze_compiled, format_analysis
+from .runtime.mesh import (batch_feeder, init_multihost, make_mesh,
+                           process_info)
 from .training.checkpoint import (latest_step, load_checkpoint,
                                   save_checkpoint)
 from .training.metrics import (MetricsWriter, ProfilerTrace,
@@ -196,6 +198,23 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "may span rows and attention may cross doc "
                         "boundaries within a row)")
 
+    g = p.add_argument_group("observability")
+    g.add_argument("--no_trace", action="store_true",
+                   help="disable the host step-timeline tracer (on by "
+                        "default; writes trace.jsonl + Perfetto-loadable "
+                        "trace.json to the logs dir — docs/OBSERVABILITY.md)")
+    g.add_argument("--no_sentinel", action="store_true",
+                   help="disable the training-health sentinel (non-finite "
+                        "loss/grad-norm halts with a state dump; loss "
+                        "spikes are flagged)")
+    g.add_argument("--sentinel_spike_factor", type=float, default=3.0,
+                   help="flag a loss spike when interval loss > factor x "
+                        "EMA (<= 0 disables spike detection only)")
+    g.add_argument("--watchdog_secs", type=float, default=300.0,
+                   help="hang watchdog: log a loud per-process report when "
+                        "no dispatch completes for this many seconds "
+                        "(0 disables)")
+
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
     g.add_argument("--profile_steps", type=int, default=0,
@@ -287,410 +306,514 @@ def train(args: argparse.Namespace) -> dict:
                          f"over both axes)")
     mesh = make_mesh(mesh_cfg)
 
-    dataloader = get_dataloader(args.data_path, args.batch_size,
-                                IGNORE_INDEX, split="train",
-                                maxlen=maxlen, shuffle=True,
-                                seed=args.random_seed,
-                                data_mode=args.data_mode)
-    vocab_size = dataloader.dataset.vocab_size
-    cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
-                      ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
-                      num_heads=pick(args.num_heads, preset.num_heads),
-                      num_kv_heads=pick(args.num_kv_heads,
-                                        preset.num_kv_heads),
-                      num_layers=pick(args.num_layers, preset.num_layers),
-                      num_experts=pick(args.num_experts, preset.num_experts),
-                      moe_top_k=pick(args.moe_top_k, preset.moe_top_k),
-                      moe_capacity_factor=pick(args.moe_capacity_factor,
-                                               preset.moe_capacity_factor),
-                      vocab_size=vocab_size, maxlen=maxlen,
-                      compute_dtype="bfloat16" if args.bf16 else "float32")
-    if args.family == "gpt2":
-        from .models.gpt2 import GPT2Transformer
-        model = GPT2Transformer(cfg, tp_size=args.tp_size,
-                                cp_size=args.cp_size, cp_impl=args.cp_impl,
-                                cp_layout=args.cp_layout,
-                                sequence_parallel=args.sequence_parallel,
-                                ep_size=args.ep_size, pp_size=args.pp_size,
-                                pp_microbatches=args.pp_microbatches,
-                                pp_remat_steps=args.pp_remat_steps,
-                                pp_schedule=args.pp_schedule,
-                                pp_virtual=args.pp_virtual,
-                                remat=REMAT_CHOICES[args.remat])
-    else:
-        model = Transformer(cfg, tp_size=args.tp_size,
-                        cp_size=args.cp_size, cp_impl=args.cp_impl,
-                        cp_layout=args.cp_layout,
-                        sequence_parallel=args.sequence_parallel,
-                        ep_size=args.ep_size, pp_size=args.pp_size,
-                        pp_microbatches=args.pp_microbatches,
-                        pp_remat_steps=args.pp_remat_steps,
-                        pp_schedule=args.pp_schedule,
-                        pp_virtual=args.pp_virtual,
-                        remat=REMAT_CHOICES[args.remat])
-    ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
-                           max_steps=args.max_steps,
-                           clip_grad_norm=args.clip_grad_norm,
-                           weight_decay=args.weight_decay,
-                           lr_schedule=args.lr_schedule,
-                           cosine_min_ratio=args.cosine_min_ratio)
-
-    params = model.init(jax.random.key(args.random_seed))
-    # count from the actual pytree: exact for every family (cfg.num_params()
-    # hardcodes the llama layout — untied head, SwiGLU, no position table)
-    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
-    moe_note = (f", {cfg.num_experts} experts (top-{cfg.moe_top_k})"
-                if cfg.num_experts else "")
-    print(f"model[{args.family}]: {n_params/1e6:.2f}M params{moe_note}, "
-          f"vocab={vocab_size}, "
-          f"mesh=dp{args.dp_size} x pp{args.pp_size} x cp{args.cp_size} x "
-          f"ep{args.ep_size} x tp{args.tp_size}, "
-          f"compute={cfg.compute_dtype}")
-    opt_state = init_adam_state(params)
-    start_step = 0
-    if args.resume:
-        if nproc > 1:
-            # Only process 0's host is assumed to hold the checkpoint files
-            # (it is the only writer — see schedule_save). It loads and
-            # broadcasts host trees; every process supplies its freshly
-            # initialised tree as the shape/dtype template.
-            last = latest_step(args.save_dir) if is_main else None
-            last = int(multihost_utils.broadcast_one_to_all(
-                np.int64(-1 if last is None else last)))
-            if last >= 0:
-                tmpl_p = model.to_canonical(params)
-                tmpl_o = _map_moments(opt_state, model.to_canonical)
-                if is_main:
-                    ck_p, ck_o, start_step = load_checkpoint(
-                        args.save_dir, last, tmpl_p,
-                        model.canonical_specs(), with_opt=True)
-                    if ck_o is None:
-                        ck_o = tmpl_o
-                else:
-                    ck_p, ck_o, start_step = tmpl_p, tmpl_o, 0
-                ck_p, ck_o = multihost_utils.broadcast_one_to_all((ck_p, ck_o))
-                start_step = int(multihost_utils.broadcast_one_to_all(
-                    np.int64(start_step)))
-                params = model.from_canonical(ck_p)
-                opt_state = _map_moments(ck_o, model.from_canonical)
-                print(f"resumed from iter {start_step} in {args.save_dir} "
-                      f"(broadcast from process 0)")
-        else:
-            last = latest_step(args.save_dir)
-            if last is not None:
-                params, opt_state, start_step = load_checkpoint(
-                    args.save_dir, last, model.to_canonical(params),
-                    model.canonical_specs(), with_opt=True)
-                params = model.from_canonical(params)
-                if opt_state is None:
-                    opt_state = init_adam_state(params)
-                else:
-                    opt_state = _map_moments(opt_state, model.from_canonical)
-                print(f"resumed from iter {start_step} in {args.save_dir}")
-
-    shardings = model.shardings(mesh)
-    params = jax.device_put(params, shardings)
-    moment_sh = (zero1_moment_shardings(model, mesh) if args.zero1
-                 else shardings)
-    opt_state = jax.device_put(
-        opt_state, opt_state.__class__(
-            step=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
-            mu=moment_sh, nu=moment_sh))
-
-    spd = max(1, args.steps_per_dispatch)
-    accum = max(1, args.grad_accum)
-    if accum > 1 and spd > 1:
-        raise SystemExit("--grad_accum and --steps_per_dispatch > 1 are "
-                         "mutually exclusive")
-    if spd > 1 and args.max_steps % spd != 0:
-        print(f"note: --max_steps {args.max_steps} is not a multiple of "
-              f"--steps_per_dispatch {spd}: the final "
-              f"{args.max_steps % spd}-step tail triggers a one-time XLA "
-              f"recompile (pick a divisible pair to avoid it)")
-    builder_kwargs = dict(zero1=args.zero1,
-                          moment_shardings=moment_sh if args.zero1 else None)
-    if accum > 1:
-        step_fn = build_grad_accum_step(model, mesh, ocfg, args.loss_mode,
-                                        **builder_kwargs)
-    elif spd > 1:
-        step_fn = build_train_step_multi(model, mesh, ocfg, args.loss_mode,
-                                         **builder_kwargs)
-    else:
-        step_fn = build_train_step(model, mesh, ocfg, args.loss_mode,
-                                   **builder_kwargs)
     # One metrics/trace dir per process in multi-host runs (the reference
     # keeps one TB dir per rank, `/root/reference/train.py:85`); TB event
     # files and profiler traces from two writers in one dir clobber.
+    # Created before model/data setup so the observer's timeline covers
+    # init and checkpoint restore too.
+    proc_idx = process_info()[0]
     logs_dir = os.path.join(args.save_dir, "logs") if nproc == 1 else \
-        os.path.join(args.save_dir, "logs", f"proc{jax.process_index()}")
-    writer = MetricsWriter(logs_dir)
+        os.path.join(args.save_dir, "logs", f"proc{proc_idx}")
+    writer = MetricsWriter(logs_dir, process_index=proc_idx)
+    observer = TrainObserver(
+        logs_dir, writer=writer, trace=not args.no_trace,
+        watchdog_secs=args.watchdog_secs, sentinel=not args.no_sentinel,
+        spike_factor=args.sentinel_spike_factor,
+        process_index=proc_idx)
 
-    # single-process: jnp.asarray; multi-host: global-array assembly from
-    # per-process shards (every process iterates the identical dataloader)
-    feed = batch_feeder(mesh)
-    # profile a window shortly after start so compile+layout churn is over
-    profiler = ProfilerTrace(logs_dir, start_step=start_step + 3,
-                             num_steps=args.profile_steps)
-    flops_step = model_flops_per_step(
-        cfg, args.batch_size, maxlen,
-        params=params if args.family == "gpt2" else None)
-    peak_flops = chip_peak_flops() * mesh_cfg.world_size
-
-    # with accumulation one optimizer step consumes `accum` batches
-    steps_per_epoch = len(dataloader) // accum
-    if steps_per_epoch == 0:
-        if args.data_mode == "packed":
-            raise SystemExit(
-                f"packed corpus yields {len(dataloader)} chunks of "
-                f"batch_size*maxlen = {args.batch_size * maxlen} tokens but "
-                f"one optimizer step needs {accum} chunk(s) (grad_accum): "
-                f"zero steps per epoch — reduce --batch_size/--maxlen/"
-                f"--grad_accum")
-        raise SystemExit(
-            f"dataset has {len(dataloader.dataset)} sequences but one "
-            f"optimizer step needs {args.batch_size * accum} "
-            f"(batch_size x grad_accum, drop_last): zero steps per epoch — "
-            f"reduce --batch_size/--grad_accum")
-    max_epoch = math.ceil(args.max_steps / steps_per_epoch)
-    # resume continues the data stream too: same seeded per-epoch order,
-    # skipping the batches already consumed
-    start_epoch = start_step // steps_per_epoch
-    skip_batches = (start_step % steps_per_epoch) * accum
-    # accumulate the loss on-device; a float() sync every step would
-    # serialize host dispatch with device execution
-    accum_loss, n = jnp.zeros((), jnp.float32), start_step
-    t_start, tokens_since, steps_since = time.time(), 0, 0
-    useful_since = 0  # non-IGNORE_INDEX targets: real tokens vs padding
-    done = False
-    shutdown = _ShutdownFlag()
-
-    _last_poll = [None]
-
-    def shutdown_agreed(step=None) -> bool:
-        """Cross-host-consistent shutdown decision. schedule_save runs a
-        collective in multi-host mode, so acting on a process-local signal
-        would send one process into an all-gather the others never enter
-        (deadlock). Every process contributes its local flag and the
-        MAX (any-of) is what all of them act on — same collective cost as
-        a broadcast, but a SIGTERM delivered to only one host (some
-        schedulers signal a single rank) still wins a shutdown checkpoint
-        everywhere (ADVICE r4). The gather blocks on device_get, so inside
-        the loop (`step` given) it runs only once per log_interval steps:
-        preemption reaction lags up to that many steps, and host dispatch
-        stays async in between."""
-        if nproc == 1:
-            return shutdown.requested
-        if step is not None:
-            if (_last_poll[0] is not None
-                    and step - _last_poll[0] < args.log_interval):
-                return False
-            _last_poll[0] = step
-        return bool(np.max(multihost_utils.process_allgather(
-            np.int32(shutdown.requested))))
-    last_saved = start_step
-    pending_save = None  # at most one async checkpoint write in flight
-    replicate_fn = []  # lazily-built jitted all-gather for multi-host saves
-
-    def join_save():
-        nonlocal pending_save
-        if pending_save is not None:
-            paths = pending_save.join()
-            print(f"saved checkpoint iter {pending_save.step}: {paths[0]}" +
-                  (f" (+{len(paths)-1} shards)" if len(paths) > 1 else ""))
-            pending_save = None
-
-    def schedule_save(step):
-        nonlocal pending_save, last_saved
-        avg = float(accum_loss) / (step - start_step)
-        join_save()  # bound in-flight async writes to one
-        save_params = model.to_canonical(params)
-        save_opt = _map_moments(opt_state, model.to_canonical)
-        if nproc > 1:
-            # Cross-host shards are not addressable from this process, so
-            # `jax.device_get` inside the writer would fail. All-gather to
-            # every host (XLA collective — all processes must participate),
-            # then only process 0 touches the filesystem. Params and the two
-            # Adam moments gather SEQUENTIALLY and land in host RAM one at a
-            # time, so peak extra device memory is one param-tree — still
-            # O(full model) per device transiently, which under --zero1
-            # means saves need that much headroom (per-host shard files
-            # would remove even that; not needed at this framework's
-            # scales).
-            if not replicate_fn:
-                replicate_fn.append(jax.jit(
-                    lambda t: t, out_shardings=jax.tree.map(
-                        lambda _: jax.sharding.NamedSharding(
-                            mesh, jax.sharding.PartitionSpec()),
-                        save_params)))
-
-            def gather_host(tree):
-                rep = replicate_fn[0](tree)
-                if is_main:
-                    return jax.device_get(rep)
-                jax.block_until_ready(rep)  # serialize; buffers free on drop
-                return None
-
-            host_p = gather_host(save_params)
-            host_mu = gather_host(save_opt.mu)
-            host_nu = gather_host(save_opt.nu)
-            if not is_main:
-                last_saved = step
-                return
-            save_params = host_p
-            save_opt = save_opt.__class__(
-                step=np.asarray(int(jax.device_get(save_opt.step)), np.int32),
-                mu=host_mu, nu=host_nu)
-        pending_save = save_checkpoint(
-            args.save_dir, step, avg, save_params,
-            model.canonical_specs(), args.tp_size, save_opt,
-            reserve_last_n=args.reserve_last_n_ckpts,
-            async_write=True)
-        last_saved = step
-
-    def shutdown_save(step):
-        """Shared by both shutdown exits (per-batch poll and post-loop)."""
-        if step > last_saved:
-            schedule_save(step)
-        print(f"shutdown requested: checkpointed at step {step}; "
-              f"restart with --resume to continue")
-
-    multi = accum > 1 or spd > 1
-    host_wait, host_dispatches = 0.0, 0
-    prefetcher = None  # closed in the finally on ANY exit (thread cleanup)
     try:
-        for epoch in range(start_epoch, max_epoch):
-            # One background thread assembles the NEXT dispatch's window
-            # (C++ collate + the spd/accum megabatch np.stack) while the
-            # device executes the current one; the main thread's per-
-            # dispatch host cost collapses to a queue pop (VERDICT r2
-            # weak #6). Windows are per-epoch: a partial spd window at the
-            # epoch boundary simply dispatches smaller (same math, batch n
-            # -> step n mapping unchanged), and a partial accum group is
-            # dropped below, exactly like the pre-prefetch loop.
-            prefetcher = Prefetcher(
-                window_stream(dataloader.epoch(epoch),
-                              accum if accum > 1 else spd,
-                              skip=skip_batches if epoch == start_epoch
-                              else 0),
-                depth=2,
-                transform=stack_window if multi else (lambda bufs: bufs[0]))
-            windows = iter(prefetcher)
-            while True:
-                wait_before = prefetcher.wait_time
-                try:
-                    window = next(windows)
-                except StopIteration:
-                    break
-                # Shutdown poll once per WINDOW: buffered/prefetched batches
-                # were never trained on, so dropping them loses nothing —
-                # resume re-reads them. Dispatch is async, so a signal
-                # arriving mid-execution is caught here before the next
-                # dispatch launches.
-                if shutdown_agreed(n):
-                    prefetcher.close()
-                    shutdown_save(n)
-                    done = True
-                    break
-                if accum > 1 and window["input_ids"].shape[0] < accum:
-                    # partial accumulation group at the epoch end: drop it
-                    # (drop_last at the optimizer-step level) so every epoch
-                    # performs exactly steps_per_epoch steps — the resume
-                    # math (start_epoch/skip_batches) relies on that
-                    continue
-                prev_n = n
-                if args.profile_steps:
-                    profiler.maybe_start(n)
-                if multi:
-                    rem = args.max_steps - n
-                    if accum == 1 and window["input_ids"].shape[0] > rem:
-                        # shrink the final window so the run ends exactly on
-                        # max_steps (one-time recompile at the tail shape)
-                        window = {k: v[:rem] for k, v in window.items()}
-                    steps_in = window["input_ids"].shape[0] if accum == 1 \
-                        else accum
-                    params, opt_state, losses = step_fn(
-                        params, opt_state,
-                        feed(window["input_ids"]),
-                        feed(window["target_ids"]),
-                        feed(window["position_ids"]))
-                    # accumulation: `losses` is already the one step's mean
-                    loss = losses if accum > 1 else jnp.sum(losses)
-                else:
-                    steps_in = 1
-                    params, opt_state, loss = step_fn(
-                        params, opt_state,
-                        feed(window["input_ids"]),
-                        feed(window["target_ids"]),
-                        feed(window["position_ids"]))
-                n += 1 if accum > 1 else steps_in
-                tokens_since += window["input_ids"].size
-                useful_since += int((window["target_ids"]
-                                     != IGNORE_INDEX).sum())
-                steps_since += steps_in
-                # only DISPATCHED pulls count toward the ms/dispatch wait
-                # metric (dropped partial groups and the end-of-epoch
-                # sentinel would deflate it)
-                host_wait += prefetcher.wait_time - wait_before
-                host_dispatches += 1
-                if args.profile_steps:
-                    profiler.maybe_stop(n, sync=loss)
-                accum_loss = accum_loss + loss
-                if n // args.log_interval > prev_n // args.log_interval:
-                    lr, _ = schedule_lr(ocfg, jnp.asarray(n - 1))
-                    avg = float(accum_loss) / (n - start_step)
-                    dt = time.time() - t_start
-                    tps = tokens_since / max(dt, 1e-9)
-                    useful = useful_since / max(tokens_since, 1)
-                    mfu = (flops_step * steps_since) / max(dt, 1e-9) / peak_flops
-                    print(f"step {n}/{args.max_steps} -> avg loss {avg:.4f}, "
-                          f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s "
-                          f"({useful*100:.0f}% useful), "
-                          f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f} GiB")
-                    writer.scalar("train/ce_loss", avg, n)
-                    writer.scalar("train/lr", float(lr), n)
-                    writer.scalar("train/tokens_per_sec", tps, n)
-                    writer.scalar("train/useful_token_frac", useful, n)
-                    writer.scalar("train/mfu", mfu, n)
-                    writer.scalar("device_memory_gib", device_memory_gib(), n)
-                    t_start, tokens_since, steps_since = time.time(), 0, 0
-                    useful_since = 0
-                if n // args.save_interval > prev_n // args.save_interval:
-                    schedule_save(n)
-                if n >= args.max_steps:
-                    done = True
-                    break
-            prefetcher.close()
-            print(f"epoch {epoch + 1}/{max_epoch} finished")
-            if done:
-                break
-        # A signal that lands during the run's FINAL dispatch exits the loop
-        # via the max_steps break without passing the per-batch poll — it
-        # must still checkpoint the trained state (the pre-multi-dispatch
-        # code polled after every step and caught this window). The
-        # n > last_saved guard keeps a signal the poll already handled from
-        # printing the shutdown message twice.
-        if n > last_saved and shutdown_agreed():
-            shutdown_save(n)
-    finally:
-        # On ANY exit (including a raising step): stop the prefetch thread
-        # (else it busy-polls its full queue forever), let the in-flight
-        # async write finish so no truncated npz is left behind, and put the
-        # previous signal handlers back so embedding callers keep Ctrl-C.
-        if prefetcher is not None:
-            prefetcher.close()
-        shutdown.restore()
-        join_save()
+        dataloader = get_dataloader(args.data_path, args.batch_size,
+                                    IGNORE_INDEX, split="train",
+                                    maxlen=maxlen, shuffle=True,
+                                    seed=args.random_seed,
+                                    data_mode=args.data_mode)
+        vocab_size = dataloader.dataset.vocab_size
+        cfg = ModelConfig(attn_dim=pick(args.attn_dim, preset.attn_dim),
+                          ffn_dim=pick(args.ffn_dim, preset.ffn_dim),
+                          num_heads=pick(args.num_heads, preset.num_heads),
+                          num_kv_heads=pick(args.num_kv_heads,
+                                            preset.num_kv_heads),
+                          num_layers=pick(args.num_layers, preset.num_layers),
+                          num_experts=pick(args.num_experts, preset.num_experts),
+                          moe_top_k=pick(args.moe_top_k, preset.moe_top_k),
+                          moe_capacity_factor=pick(args.moe_capacity_factor,
+                                                   preset.moe_capacity_factor),
+                          vocab_size=vocab_size, maxlen=maxlen,
+                          compute_dtype="bfloat16" if args.bf16 else "float32")
+        if args.family == "gpt2":
+            from .models.gpt2 import GPT2Transformer
+            model = GPT2Transformer(cfg, tp_size=args.tp_size,
+                                    cp_size=args.cp_size, cp_impl=args.cp_impl,
+                                    cp_layout=args.cp_layout,
+                                    sequence_parallel=args.sequence_parallel,
+                                    ep_size=args.ep_size, pp_size=args.pp_size,
+                                    pp_microbatches=args.pp_microbatches,
+                                    pp_remat_steps=args.pp_remat_steps,
+                                    pp_schedule=args.pp_schedule,
+                                    pp_virtual=args.pp_virtual,
+                                    remat=REMAT_CHOICES[args.remat])
+        else:
+            model = Transformer(cfg, tp_size=args.tp_size,
+                            cp_size=args.cp_size, cp_impl=args.cp_impl,
+                            cp_layout=args.cp_layout,
+                            sequence_parallel=args.sequence_parallel,
+                            ep_size=args.ep_size, pp_size=args.pp_size,
+                            pp_microbatches=args.pp_microbatches,
+                            pp_remat_steps=args.pp_remat_steps,
+                            pp_schedule=args.pp_schedule,
+                            pp_virtual=args.pp_virtual,
+                            remat=REMAT_CHOICES[args.remat])
+        ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
+                               max_steps=args.max_steps,
+                               clip_grad_norm=args.clip_grad_norm,
+                               weight_decay=args.weight_decay,
+                               lr_schedule=args.lr_schedule,
+                               cosine_min_ratio=args.cosine_min_ratio)
 
-    final_avg = float(accum_loss) / max(n - start_step, 1)
-    profiler.close(sync=accum_loss)
-    writer.close()
-    if host_dispatches:
-        print(f"input pipeline: host waited "
-              f"{1e3 * host_wait / host_dispatches:.2f} ms/dispatch for "
-              f"data ({host_dispatches} dispatches; collate+stack ran on "
-              f"the prefetch thread)")
-    print(f"training finished at step {n}, avg loss {final_avg:.4f}")
-    return {"steps": n, "avg_loss": final_avg}
+        params = model.init(jax.random.key(args.random_seed))
+        # count from the actual pytree: exact for every family (cfg.num_params()
+        # hardcodes the llama layout — untied head, SwiGLU, no position table)
+        n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+        moe_note = (f", {cfg.num_experts} experts (top-{cfg.moe_top_k})"
+                    if cfg.num_experts else "")
+        print(f"model[{args.family}]: {n_params/1e6:.2f}M params{moe_note}, "
+              f"vocab={vocab_size}, "
+              f"mesh=dp{args.dp_size} x pp{args.pp_size} x cp{args.cp_size} x "
+              f"ep{args.ep_size} x tp{args.tp_size}, "
+              f"compute={cfg.compute_dtype}")
+        opt_state = init_adam_state(params)
+        start_step = 0
+        if args.resume:
+            if nproc > 1:
+                # Only process 0's host is assumed to hold the checkpoint files
+                # (it is the only writer — see schedule_save). It loads and
+                # broadcasts host trees; every process supplies its freshly
+                # initialised tree as the shape/dtype template.
+                last = latest_step(args.save_dir) if is_main else None
+                last = int(multihost_utils.broadcast_one_to_all(
+                    np.int64(-1 if last is None else last)))
+                if last >= 0:
+                    tmpl_p = model.to_canonical(params)
+                    tmpl_o = _map_moments(opt_state, model.to_canonical)
+                    if is_main:
+                        with observer.span("checkpoint", "restore", step=last):
+                            ck_p, ck_o, start_step = load_checkpoint(
+                                args.save_dir, last, tmpl_p,
+                                model.canonical_specs(), with_opt=True)
+                        if ck_o is None:
+                            ck_o = tmpl_o
+                    else:
+                        ck_p, ck_o, start_step = tmpl_p, tmpl_o, 0
+                    ck_p, ck_o = multihost_utils.broadcast_one_to_all((ck_p, ck_o))
+                    start_step = int(multihost_utils.broadcast_one_to_all(
+                        np.int64(start_step)))
+                    params = model.from_canonical(ck_p)
+                    opt_state = _map_moments(ck_o, model.from_canonical)
+                    print(f"resumed from iter {start_step} in {args.save_dir} "
+                          f"(broadcast from process 0)")
+            else:
+                last = latest_step(args.save_dir)
+                if last is not None:
+                    with observer.span("checkpoint", "restore", step=last):
+                        params, opt_state, start_step = load_checkpoint(
+                            args.save_dir, last, model.to_canonical(params),
+                            model.canonical_specs(), with_opt=True)
+                    params = model.from_canonical(params)
+                    if opt_state is None:
+                        opt_state = init_adam_state(params)
+                    else:
+                        opt_state = _map_moments(opt_state, model.from_canonical)
+                    print(f"resumed from iter {start_step} in {args.save_dir}")
+
+        shardings = model.shardings(mesh)
+        params = jax.device_put(params, shardings)
+        moment_sh = (zero1_moment_shardings(model, mesh) if args.zero1
+                     else shardings)
+        opt_state = jax.device_put(
+            opt_state, opt_state.__class__(
+                step=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
+                mu=moment_sh, nu=moment_sh))
+
+        spd = max(1, args.steps_per_dispatch)
+        accum = max(1, args.grad_accum)
+        if accum > 1 and spd > 1:
+            raise SystemExit("--grad_accum and --steps_per_dispatch > 1 "
+                             "are mutually exclusive")
+        if spd > 1 and args.max_steps % spd != 0:
+            print(f"note: --max_steps {args.max_steps} is not a multiple of "
+                  f"--steps_per_dispatch {spd}: the final "
+                  f"{args.max_steps % spd}-step tail triggers a one-time XLA "
+                  f"recompile (pick a divisible pair to avoid it)")
+        builder_kwargs = dict(zero1=args.zero1,
+                              moment_shardings=moment_sh if args.zero1 else None,
+                              with_grad_norm=True)
+        if accum > 1:
+            step_fn = build_grad_accum_step(model, mesh, ocfg, args.loss_mode,
+                                            **builder_kwargs)
+        elif spd > 1:
+            step_fn = build_train_step_multi(model, mesh, ocfg, args.loss_mode,
+                                             **builder_kwargs)
+        else:
+            step_fn = build_train_step(model, mesh, ocfg, args.loss_mode,
+                                       **builder_kwargs)
+
+        # single-process: jnp.asarray; multi-host: global-array assembly from
+        # per-process shards (every process iterates the identical dataloader)
+        feed = batch_feeder(mesh)
+        # profile a window shortly after start so compile+layout churn is over
+        profiler = ProfilerTrace(logs_dir, start_step=start_step + 3,
+                                 num_steps=args.profile_steps)
+        flops_step = model_flops_per_step(
+            cfg, args.batch_size, maxlen,
+            params=params if args.family == "gpt2" else None)
+        peak_flops = chip_peak_flops() * mesh_cfg.world_size
+
+        # The steady-shape program is AOT-compiled explicitly (under a traced
+        # "compile" span) and introspected once — cost_analysis FLOPs, bytes,
+        # per-collective comm, peak HBM — then called directly each dispatch.
+        # Odd shapes (the max_steps tail window) and backends that reject AOT
+        # calls fall back to the jit wrapper, whose recompile lands inside the
+        # "step" span.
+        aot = {"shape": None, "fn": None}
+
+        def run_step(p, o, ids, tgt, pos, steps_in, step_no):
+            # pin only the STEADY shape: a shrunk tail / partial epoch-end
+            # window (spd mode) must not claim the AOT slot, or the
+            # introspection would describe a program the run barely
+            # executes and every full window would miss the cache
+            steady = accum > 1 or steps_in == spd
+            if aot["shape"] is None and steady:
+                aot["shape"] = ids.shape
+                with observer.span("compile", step=step_no):
+                    try:
+                        aot["fn"] = step_fn.lower(p, o, ids, tgt, pos).compile()
+                    except Exception as e:
+                        print(f"note: AOT compile unavailable "
+                              f"({type(e).__name__}: {e}); introspection "
+                              f"skipped, using the jit path")
+                if aot["fn"] is not None:
+                    analysis = analyze_compiled(aot["fn"])
+                    # SPMD HLO is per-device: the hand-rolled global estimate
+                    # spreads over world_size devices (and x steps_in for the
+                    # scanned/accumulated multi-batch programs)
+                    expected = flops_step * steps_in / mesh_cfg.world_size
+                    observer.report_compiled(analysis, flops_step,
+                                             steps_in_program=steps_in,
+                                             expected_flops=expected,
+                                             step=step_no)
+                    if is_main:
+                        print(format_analysis(analysis, model_flops=expected))
+            fn = aot["fn"] if (aot["fn"] is not None
+                               and ids.shape == aot["shape"]) else step_fn
+            with observer.span("step", step=step_no):
+                try:
+                    return fn(p, o, ids, tgt, pos)
+                except (TypeError, ValueError):
+                    if fn is step_fn:
+                        raise
+                    # AOT input validation (shape/layout/sharding mismatch)
+                    # surfaces before execution — nothing donated yet — so
+                    # downgrading to the jit wrapper, which reshards freely,
+                    # is safe
+                    aot["fn"] = None
+                    return step_fn(p, o, ids, tgt, pos)
+
+        # with accumulation one optimizer step consumes `accum` batches
+        steps_per_epoch = len(dataloader) // accum
+        if steps_per_epoch == 0:
+            if args.data_mode == "packed":
+                raise SystemExit(
+                    f"packed corpus yields {len(dataloader)} chunks of "
+                    f"batch_size*maxlen = {args.batch_size * maxlen} tokens but "
+                    f"one optimizer step needs {accum} chunk(s) (grad_accum): "
+                    f"zero steps per epoch — reduce --batch_size/--maxlen/"
+                    f"--grad_accum")
+            raise SystemExit(
+                f"dataset has {len(dataloader.dataset)} sequences but one "
+                f"optimizer step needs {args.batch_size * accum} "
+                f"(batch_size x grad_accum, drop_last): zero steps per epoch — "
+                f"reduce --batch_size/--grad_accum")
+        max_epoch = math.ceil(args.max_steps / steps_per_epoch)
+        # resume continues the data stream too: same seeded per-epoch order,
+        # skipping the batches already consumed
+        start_epoch = start_step // steps_per_epoch
+        skip_batches = (start_step % steps_per_epoch) * accum
+        # accumulate the loss on-device; a float() sync every step would
+        # serialize host dispatch with device execution
+        accum_loss, n = jnp.zeros((), jnp.float32), start_step
+        # the sentinel piggybacks on the logging-interval sync: last dispatch's
+        # on-device grad norm + the per-interval mean loss, no extra D2H
+        last_gnorm = None
+        last_cum, last_log_n = 0.0, start_step
+        t_start, tokens_since, steps_since = time.time(), 0, 0
+        useful_since = 0  # non-IGNORE_INDEX targets: real tokens vs padding
+        done = False
+        shutdown = _ShutdownFlag()
+
+        _last_poll = [None]
+
+        def shutdown_agreed(step=None) -> bool:
+            """Cross-host-consistent shutdown decision. schedule_save runs a
+            collective in multi-host mode, so acting on a process-local signal
+            would send one process into an all-gather the others never enter
+            (deadlock). Every process contributes its local flag and the
+            MAX (any-of) is what all of them act on — same collective cost as
+            a broadcast, but a SIGTERM delivered to only one host (some
+            schedulers signal a single rank) still wins a shutdown checkpoint
+            everywhere (ADVICE r4). The gather blocks on device_get, so inside
+            the loop (`step` given) it runs only once per log_interval steps:
+            preemption reaction lags up to that many steps, and host dispatch
+            stays async in between."""
+            if nproc == 1:
+                return shutdown.requested
+            if step is not None:
+                if (_last_poll[0] is not None
+                        and step - _last_poll[0] < args.log_interval):
+                    return False
+                _last_poll[0] = step
+            return bool(np.max(multihost_utils.process_allgather(
+                np.int32(shutdown.requested))))
+        last_saved = start_step
+        pending_save = None  # at most one async checkpoint write in flight
+        replicate_fn = []  # lazily-built jitted all-gather for multi-host saves
+
+        def join_save():
+            nonlocal pending_save
+            if pending_save is not None:
+                with observer.span("checkpoint", "join_save",
+                                   step=pending_save.step):
+                    paths = pending_save.join()
+                print(f"saved checkpoint iter {pending_save.step}: {paths[0]}" +
+                      (f" (+{len(paths)-1} shards)" if len(paths) > 1 else ""))
+                pending_save = None
+
+        def schedule_save(step):
+            with observer.span("checkpoint", "schedule_save", step=step):
+                _schedule_save(step)
+
+        def _schedule_save(step):
+            nonlocal pending_save, last_saved
+            avg = float(accum_loss) / (step - start_step)
+            join_save()  # bound in-flight async writes to one
+            save_params = model.to_canonical(params)
+            save_opt = _map_moments(opt_state, model.to_canonical)
+            if nproc > 1:
+                # Cross-host shards are not addressable from this process, so
+                # `jax.device_get` inside the writer would fail. All-gather to
+                # every host (XLA collective — all processes must participate),
+                # then only process 0 touches the filesystem. Params and the two
+                # Adam moments gather SEQUENTIALLY and land in host RAM one at a
+                # time, so peak extra device memory is one param-tree — still
+                # O(full model) per device transiently, which under --zero1
+                # means saves need that much headroom (per-host shard files
+                # would remove even that; not needed at this framework's
+                # scales).
+                if not replicate_fn:
+                    replicate_fn.append(jax.jit(
+                        lambda t: t, out_shardings=jax.tree.map(
+                            lambda _: jax.sharding.NamedSharding(
+                                mesh, jax.sharding.PartitionSpec()),
+                            save_params)))
+
+                def gather_host(tree):
+                    rep = replicate_fn[0](tree)
+                    if is_main:
+                        return jax.device_get(rep)
+                    jax.block_until_ready(rep)  # serialize; buffers free on drop
+                    return None
+
+                host_p = gather_host(save_params)
+                host_mu = gather_host(save_opt.mu)
+                host_nu = gather_host(save_opt.nu)
+                if not is_main:
+                    last_saved = step
+                    return
+                save_params = host_p
+                save_opt = save_opt.__class__(
+                    step=np.asarray(int(jax.device_get(save_opt.step)), np.int32),
+                    mu=host_mu, nu=host_nu)
+            pending_save = save_checkpoint(
+                args.save_dir, step, avg, save_params,
+                model.canonical_specs(), args.tp_size, save_opt,
+                reserve_last_n=args.reserve_last_n_ckpts,
+                async_write=True, tracer=observer.tracer)
+            last_saved = step
+
+        def shutdown_save(step):
+            """Shared by both shutdown exits (per-batch poll and post-loop)."""
+            if step > last_saved:
+                schedule_save(step)
+            print(f"shutdown requested: checkpointed at step {step}; "
+                  f"restart with --resume to continue")
+
+        multi = accum > 1 or spd > 1
+        host_wait, host_dispatches = 0.0, 0
+        prefetcher = None  # closed in the finally on ANY exit (thread cleanup)
+        try:
+            for epoch in range(start_epoch, max_epoch):
+                # One background thread assembles the NEXT dispatch's window
+                # (C++ collate + the spd/accum megabatch np.stack) while the
+                # device executes the current one; the main thread's per-
+                # dispatch host cost collapses to a queue pop (VERDICT r2
+                # weak #6). Windows are per-epoch: a partial spd window at the
+                # epoch boundary simply dispatches smaller (same math, batch n
+                # -> step n mapping unchanged), and a partial accum group is
+                # dropped below, exactly like the pre-prefetch loop.
+                prefetcher = Prefetcher(
+                    window_stream(dataloader.epoch(epoch),
+                                  accum if accum > 1 else spd,
+                                  skip=skip_batches if epoch == start_epoch
+                                  else 0),
+                    depth=2,
+                    transform=stack_window if multi else (lambda bufs: bufs[0]),
+                    tracer=observer.tracer)
+                windows = iter(prefetcher)
+                while True:
+                    wait_before = prefetcher.wait_time
+                    try:
+                        with observer.span("data_wait"):
+                            window = next(windows)
+                    except StopIteration:
+                        break
+                    # Shutdown poll once per WINDOW: buffered/prefetched batches
+                    # were never trained on, so dropping them loses nothing —
+                    # resume re-reads them. Dispatch is async, so a signal
+                    # arriving mid-execution is caught here before the next
+                    # dispatch launches.
+                    if shutdown_agreed(n):
+                        prefetcher.close()
+                        shutdown_save(n)
+                        done = True
+                        break
+                    if accum > 1 and window["input_ids"].shape[0] < accum:
+                        # partial accumulation group at the epoch end: drop it
+                        # (drop_last at the optimizer-step level) so every epoch
+                        # performs exactly steps_per_epoch steps — the resume
+                        # math (start_epoch/skip_batches) relies on that
+                        continue
+                    prev_n = n
+                    if args.profile_steps:
+                        profiler.maybe_start(n)
+                    if multi:
+                        rem = args.max_steps - n
+                        if accum == 1 and window["input_ids"].shape[0] > rem:
+                            # shrink the final window so the run ends exactly on
+                            # max_steps (one-time recompile at the tail shape)
+                            window = {k: v[:rem] for k, v in window.items()}
+                        steps_in = window["input_ids"].shape[0] if accum == 1 \
+                            else accum
+                    else:
+                        steps_in = 1
+                    with observer.span("h2d"):
+                        ids = feed(window["input_ids"])
+                        tgt = feed(window["target_ids"])
+                        pos = feed(window["position_ids"])
+                    params, opt_state, out = run_step(params, opt_state, ids,
+                                                      tgt, pos, steps_in, n)
+                    if multi:
+                        losses, gnorms = out
+                        # accumulation: `losses` is already the one step's mean
+                        loss = losses if accum > 1 else jnp.sum(losses)
+                        last_gnorm = gnorms if accum > 1 else gnorms[-1]
+                    else:
+                        loss, last_gnorm = out
+                    n += 1 if accum > 1 else steps_in
+                    tokens_since += window["input_ids"].size
+                    useful_since += int((window["target_ids"]
+                                         != IGNORE_INDEX).sum())
+                    steps_since += steps_in
+                    observer.heartbeat(n, tokens=window["input_ids"].size,
+                                       steps=steps_in)
+                    # only DISPATCHED pulls count toward the ms/dispatch wait
+                    # metric (dropped partial groups and the end-of-epoch
+                    # sentinel would deflate it)
+                    host_wait += prefetcher.wait_time - wait_before
+                    host_dispatches += 1
+                    if args.profile_steps:
+                        profiler.maybe_stop(n, sync=loss)
+                    accum_loss = accum_loss + loss
+                    if n // args.log_interval > prev_n // args.log_interval:
+                        lr, _ = schedule_lr(ocfg, jnp.asarray(n - 1))
+                        # the one blocking D2H of the interval: cumulative loss
+                        # + last dispatch's grad norm ride the same sync
+                        with observer.span("step", "device_sync", step=n):
+                            cum = float(accum_loss)
+                            gnorm = (float(last_gnorm)
+                                     if last_gnorm is not None else None)
+                        avg = cum / (n - start_step)
+                        interval_loss = (cum - last_cum) / max(n - last_log_n, 1)
+                        dt = time.time() - t_start
+                        tps = tokens_since / max(dt, 1e-9)
+                        useful = useful_since / max(tokens_since, 1)
+                        mfu = (flops_step * steps_since) / max(dt, 1e-9) / peak_flops
+                        print(f"step {n}/{args.max_steps} -> avg loss {avg:.4f}, "
+                              f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s "
+                              f"({useful*100:.0f}% useful), "
+                              f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f} GiB")
+                        writer.scalar("train/ce_loss", avg, n)
+                        writer.scalar("train/lr", float(lr), n)
+                        writer.scalar("train/tokens_per_sec", tps, n)
+                        writer.scalar("train/useful_token_frac", useful, n)
+                        writer.scalar("train/mfu", mfu, n)
+                        writer.scalar("device_memory_gib", device_memory_gib(), n)
+                        if gnorm is not None:
+                            writer.scalar("train/grad_norm", gnorm, n)
+                        last_cum, last_log_n = cum, n
+                        t_start, tokens_since, steps_since = time.time(), 0, 0
+                        useful_since = 0
+                        # after the metrics land on disk: a non-finite interval
+                        # raises TrainingHealthError through the finally below
+                        observer.check_health(n, interval_loss, gnorm)
+                    if n // args.save_interval > prev_n // args.save_interval:
+                        schedule_save(n)
+                    if n >= args.max_steps:
+                        done = True
+                        break
+                prefetcher.close()
+                print(f"epoch {epoch + 1}/{max_epoch} finished")
+                if done:
+                    break
+            # A signal that lands during the run's FINAL dispatch exits the loop
+            # via the max_steps break without passing the per-batch poll — it
+            # must still checkpoint the trained state (the pre-multi-dispatch
+            # code polled after every step and caught this window). The
+            # n > last_saved guard keeps a signal the poll already handled from
+            # printing the shutdown message twice.
+            if n > last_saved and shutdown_agreed():
+                shutdown_save(n)
+        finally:
+            # On ANY exit (including a raising step): stop the prefetch thread
+            # (else it busy-polls its full queue forever), let the in-flight
+            # async write finish so no truncated npz is left behind, and put the
+            # previous signal handlers back so embedding callers keep Ctrl-C.
+            # The observer closes here too, so a sentinel halt still leaves a
+            # complete trace.json + goodput summary behind; the writer closes
+            # last (the observer logs its summary through it).
+            if prefetcher is not None:
+                prefetcher.close()
+            shutdown.restore()
+            join_save()
+            observer.close(print_summary=is_main)
+            writer.close()
+
+        final_avg = float(accum_loss) / max(n - start_step, 1)
+        profiler.close(sync=accum_loss)
+        if host_dispatches:
+            print(f"input pipeline: host waited "
+                  f"{1e3 * host_wait / host_dispatches:.2f} ms/dispatch for "
+                  f"data ({host_dispatches} dispatches; collate+stack ran on "
+                  f"the prefetch thread)")
+        print(f"training finished at step {n}, avg loss {final_avg:.4f}")
+        return {"steps": n, "avg_loss": final_avg}
+    except BaseException:
+        # Exceptions BEFORE the loop's own try/finally (bad data path,
+        # validation SystemExits, model-init failures) must not leak the
+        # watchdog thread or the open trace/metrics handles when train()
+        # is embedded (tests call it repeatedly). Both closes are
+        # idempotent, so the happy path's finally running first is fine.
+        observer.close(print_summary=False)
+        writer.close()
+        raise
 
 
 def main(argv=None):

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from typing import Optional
 
@@ -18,9 +19,28 @@ import jax
 
 
 class MetricsWriter:
-    def __init__(self, log_dir: str):
+    """Multihost-safe: process 0 writes `metrics.jsonl`, every other
+    process writes `metrics.proc{i}.jsonl`, so concurrent processes
+    sharing one log dir never interleave lines in one file. TensorBoard
+    stays per-rank (its tfevents filenames embed hostname+pid, so writers
+    never clobber even in a shared dir) — per-host curves are how
+    multi-host divergence is compared. Also a context manager, so the
+    file handle closes on error paths."""
+
+    def __init__(self, log_dir: str, process_index: Optional[int] = None):
+        from ..runtime.mesh import process_info
+        if process_index is None:
+            process_index = process_info()[0]
+        self.process_index = process_index
         os.makedirs(log_dir, exist_ok=True)
-        self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+        name = ("metrics.jsonl" if process_index == 0
+                else f"metrics.proc{process_index}.jsonl")
+        self.path = os.path.join(log_dir, name)
+        self._jsonl = open(self.path, "a")
+        # the obs watchdog writes events from its daemon thread while the
+        # train loop writes scalars — serialize, or lines tear
+        self._lock = threading.Lock()
+        self._closed = False
         self._tb = None
         try:
             from tensorboardX import SummaryWriter  # optional
@@ -28,30 +48,55 @@ class MetricsWriter:
         except Exception:
             pass
 
+    def _write(self, rec: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+
     def scalar(self, tag: str, value: float, step: int) -> None:
-        self._jsonl.write(json.dumps(
-            {"tag": tag, "value": float(value), "step": int(step),
-             "ts": time.time()}) + "\n")
-        self._jsonl.flush()
-        if self._tb is not None:
+        self._write({"tag": tag, "value": float(value), "step": int(step),
+                     "ts": time.time()})
+        # post-close writes drop entirely: tensorboardX would resurrect a
+        # fresh, never-flushed event file on a late add_scalar
+        if self._tb is not None and not self._closed:
             self._tb.add_scalar(tag, value, step)
 
     def text(self, tag: str, value: str, step: int = 0) -> None:
-        self._jsonl.write(json.dumps(
-            {"tag": tag, "text": value, "step": int(step)}) + "\n")
-        self._jsonl.flush()
-        if self._tb is not None:
+        self._write({"tag": tag, "text": value, "step": int(step)})
+        if self._tb is not None and not self._closed:
             self._tb.add_text(tag, value, step)
 
+    def event(self, tag: str, step: Optional[int] = None, **fields) -> None:
+        """Structured one-off record (goodput summary, sentinel/watchdog
+        events, cost analysis) — jsonl only; TB has no sane rendering for
+        these."""
+        rec = {"tag": tag, "ts": time.time(), **fields}
+        if step is not None:
+            rec["step"] = int(step)
+        self._write(rec)
+
     def close(self) -> None:
-        self._jsonl.close()
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._jsonl.close()
         if self._tb is not None:
             self._tb.close()
+
+    def __enter__(self) -> "MetricsWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 # Peak bf16 FLOP/s per chip by device_kind, most-specific prefix first
 # (v5p must not fall into the 'TPU v5' bucket). Used for MFU reporting.
 PEAK_FLOPS = [
+    ("TPU v7", 2307e12),       # Ironwood: 4.6 PFLOP/s fp8 -> ~2.3 bf16
     ("TPU v6 lite", 918e12),   # v6e / Trillium
     ("TPU v6", 918e12),
     ("TPU v5p", 459e12),
@@ -60,12 +105,21 @@ PEAK_FLOPS = [
     ("TPU v4", 275e12),
 ]
 
+_warned_unknown_kind = set()
+
 
 def chip_peak_flops(device: Optional[jax.Device] = None) -> float:
     kind = (device or jax.devices()[0]).device_kind
     for prefix, v in PEAK_FLOPS:
         if kind.startswith(prefix):
             return v
+    if kind not in _warned_unknown_kind:  # once per kind, not per call
+        _warned_unknown_kind.add(kind)
+        import sys
+        print(f"Warning: unknown device_kind {kind!r} — assuming v5e peak "
+              f"({197e12 / 1e12:.0f} TFLOP/s); MFU numbers are unreliable "
+              f"until PEAK_FLOPS (training/metrics.py) gains an entry",
+              file=sys.stderr)  # bench.py's stdout is machine-parsed
     return 197e12  # unknown: assume v5e
 
 

@@ -115,12 +115,18 @@ def schedule_lr(cfg: OptimizerConfig, step: jax.Array) -> Tuple[jax.Array, jax.A
     return onecycle_lr(cfg, step)
 
 
+def global_norm(grads: Any) -> jnp.ndarray:
+    """Global L2 norm over a gradient pytree, reduced in float32 — shared
+    by the clipper below and the train step's logged/sentinel-watched
+    grad norm (training/train_step.py), so the two can never diverge."""
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree.leaves(grads)))
+
+
 def clip_by_global_norm(grads: Any, max_norm: float) -> Any:
     """torch `clip_grad_norm_` semantics: one L2 norm over every grad leaf,
     scaled by max_norm/(norm + 1e-6) only when the norm exceeds max_norm."""
-    leaves = jax.tree.leaves(grads)
-    norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                        for g in leaves))
+    norm = global_norm(grads)
     scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
     return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype),
                         grads)

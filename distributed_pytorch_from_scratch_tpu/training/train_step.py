@@ -19,22 +19,31 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config import OptimizerConfig, TrainConfig
 from ..models.transformer import Transformer
-from .optim import AdamState, adam_update
+from .optim import AdamState, adam_update, global_norm
 from .zero import zero1_moment_shardings
 
 
 def _step_body(model: Transformer, mesh, ocfg: OptimizerConfig,
-               loss_mode: str):
+               loss_mode: str, with_grad_norm: bool = False):
     """The one train-step body shared by both builders: grad + Adam/OneCycle.
     Keeping it single-sourced means the scanned (multi-step) program can
-    never silently diverge from the per-step one."""
+    never silently diverge from the per-step one.
+
+    `with_grad_norm=True` (the train CLI's mode) makes the third output
+    `(loss, grad_norm)` instead of `loss` — computed on-device inside the
+    same program, fetched only at the loop's logging-interval D2H, so the
+    sentinel costs no extra syncs."""
     grad_fn = jax.value_and_grad(model.make_loss(mesh, mode=loss_mode))
 
     def step(params, opt_state: AdamState, input_ids, target_ids,
              position_ids):
         loss, grads = grad_fn(params, input_ids, target_ids, position_ids)
+        # grad norm: optim.global_norm — the SAME reduction the clipper
+        # uses, so the logged/sentinel-watched norm equals the one
+        # acted on (and XLA can CSE the two when both are present)
+        out = (loss, global_norm(grads)) if with_grad_norm else loss
         params, opt_state = adam_update(ocfg, params, grads, opt_state)
-        return params, opt_state, loss
+        return params, opt_state, out
 
     return step
 
@@ -55,25 +64,38 @@ def _jit_with_zero1(fn, model, mesh, zero1, moment_shardings, loss_sharding):
                  else zero1_moment_shardings(model, mesh))
     scalar = NamedSharding(mesh, P())
     opt_sh = AdamState(step=scalar, mu=moment_sh, nu=moment_sh)
+
+    def shard_tree(spec):
+        # isinstance-P first: PartitionSpec is tuple-like on older jax
+        if isinstance(spec, P):
+            return NamedSharding(mesh, spec)
+        return tuple(shard_tree(s) for s in spec)
+
     return jax.jit(fn, donate_argnums=(0, 1),
                    out_shardings=(param_sh, opt_sh,
-                                  NamedSharding(mesh, loss_sharding)))
+                                  shard_tree(loss_sharding)))
 
 
 def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
                      loss_mode: str = "vocab_parallel",
-                     zero1: bool = False, moment_shardings=None):
+                     zero1: bool = False, moment_shardings=None,
+                     with_grad_norm: bool = False):
     """Returns jitted
     (params, opt_state, input_ids, target_ids, position_ids)
-      -> (params, opt_state, loss).
+      -> (params, opt_state, loss)            [default]
+      -> (params, opt_state, (loss, gnorm))   [with_grad_norm=True]
     """
-    step = _step_body(model, mesh, ocfg, loss_mode)
-    return _jit_with_zero1(step, model, mesh, zero1, moment_shardings, P())
+    step = _step_body(model, mesh, ocfg, loss_mode,
+                      with_grad_norm=with_grad_norm)
+    out_spec = (P(), P()) if with_grad_norm else P()
+    return _jit_with_zero1(step, model, mesh, zero1, moment_shardings,
+                           out_spec)
 
 
 def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
                            loss_mode: str = "vocab_parallel",
-                           zero1: bool = False, moment_shardings=None):
+                           zero1: bool = False, moment_shardings=None,
+                           with_grad_norm: bool = False):
     """Multi-step-per-dispatch variant: one jitted program runs
     `lax.scan` over a leading steps axis of the batch.
 
@@ -89,25 +111,29 @@ def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
     one `optimizer.step()` per Python iteration
     (`/root/reference/train.py:94-109`).
     """
-    step = _step_body(model, mesh, ocfg, loss_mode)
+    step = _step_body(model, mesh, ocfg, loss_mode,
+                      with_grad_norm=with_grad_norm)
 
     def multi_step(params, opt_state: AdamState, input_ids, target_ids,
                    position_ids):
         def body(carry, batch):
-            p, o, loss = step(*carry, *batch)
-            return (p, o), loss
+            p, o, out = step(*carry, *batch)
+            return (p, o), out
 
-        (params, opt_state), losses = jax.lax.scan(
+        (params, opt_state), outs = jax.lax.scan(
             body, (params, opt_state), (input_ids, target_ids, position_ids))
-        return params, opt_state, losses
+        # with_grad_norm: outs is (losses(N), gnorms(N)) — scan stacks each
+        return params, opt_state, outs
 
+    out_spec = (P(None), P(None)) if with_grad_norm else P(None)
     return _jit_with_zero1(multi_step, model, mesh, zero1, moment_shardings,
-                           P(None))
+                           out_spec)
 
 
 def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
                           loss_mode: str = "vocab_parallel",
-                          zero1: bool = False, moment_shardings=None):
+                          zero1: bool = False, moment_shardings=None,
+                          with_grad_norm: bool = False):
     """Gradient accumulation: ONE optimizer step from the MEAN of the
     microbatch gradients.
 
@@ -138,9 +164,14 @@ def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
             (input_ids, target_ids, position_ids))
         a = input_ids.shape[0]
         grads = jax.tree.map(lambda x: x / a, g_sum)
+        # the norm of the MEAN gradient — the quantity Adam actually sees
+        out = ((loss_sum / a, global_norm(grads)) if with_grad_norm
+               else loss_sum / a)
         params, opt_state = adam_update(ocfg, params, grads, opt_state)
-        return params, opt_state, loss_sum / a
+        return params, opt_state, out
 
-    return _jit_with_zero1(step, model, mesh, zero1, moment_shardings, P())
+    out_spec = (P(), P()) if with_grad_norm else P()
+    return _jit_with_zero1(step, model, mesh, zero1, moment_shardings,
+                           out_spec)
 
 

@@ -76,6 +76,49 @@ def eval_summary(rdir):
     return vals, decodes[:8]
 
 
+def obs_lines(rdir):
+    """Goodput summaries, compiled-program cost analyses, and sentinel/
+    watchdog events from every metrics*.jsonl under the runs dir (train
+    writes them to <save_dir>/logs/; multihost procs tag their filenames).
+    Returns (goodput_rows, health_rows)."""
+    rows_g, rows_h = [], []
+    for p in sorted(glob.glob(os.path.join(rdir, "**", "metrics*.jsonl"),
+                              recursive=True)):
+        rel = os.path.relpath(p, rdir)
+        for line in open(p, errors="replace"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            tag = rec.get("tag")
+            if tag == "goodput_summary":
+                b = rec.get("buckets_s", {})
+                top = ", ".join(f"{k} {v:.1f}s" for k, v in
+                                sorted(b.items(), key=lambda kv: -kv[1])[:4])
+                rows_g.append(f"- `{rel}`: goodput "
+                              f"{100 * rec.get('goodput', 0):.1f}% over "
+                              f"{rec.get('wall_s', 0):.1f}s wall "
+                              f"({rec.get('steps', 0)} steps; {top})")
+            elif tag == "cost_analysis":
+                flops, exp = rec.get("flops"), rec.get(
+                    "expected_program_flops")
+                if flops and exp:
+                    rows_g.append(
+                        f"- `{rel}`: XLA {flops / 1e9:.2f} GFLOPs/program "
+                        f"= {flops / exp:.2f}x the hand-rolled estimate; "
+                        f"comm {rec.get('comm_bytes', 0) / 2**20:.1f} "
+                        f"MiB/program; peak HBM "
+                        f"{rec.get('peak_hbm_bytes', 0) / 2**30:.2f} GiB")
+            elif tag in ("sentinel/nonfinite", "sentinel/loss_spike",
+                         "watchdog/stall", "watchdog/recovered"):
+                why = rec.get("reason") or ""
+                # sentinel events carry 'step'; watchdog ones 'last_step'
+                step = rec.get("step", rec.get("last_step", "?"))
+                rows_h.append(f"- `{rel}` step {step}: "
+                              f"{tag}" + (f" — {why}" if why else ""))
+    return rows_g, rows_h
+
+
 def manifest_failures(rdir):
     """Steps that failed, from the run_step manifest — forensics inline."""
     path = os.path.join(rdir, "session_manifest.jsonl")
@@ -111,6 +154,15 @@ def summarize(rdir):
     for log in ("train.log", "train_packed.log"):
         s = train_summary(rdir, log)
         out.append(s if s else f"{log}: not started.")
+    goodput, health = obs_lines(rdir)
+    if goodput:
+        out.append("")
+        out.append("Goodput / compiled-program accounting:")
+        out.extend(goodput)
+    if health:
+        out.append("")
+        out.append("Training-health events (sentinel/watchdog):")
+        out.extend(health)
     vals, decodes = eval_summary(rdir)
     if vals:
         out.append("")

@@ -80,6 +80,14 @@ CORE_LANE = {
     "test_data_pipeline.py": ["test_collate_semantics",
                               "test_token_json_schema",
                               "test_reference_shipped_tokenizer_loads"],
+    # obs: cheap unit coverage of every component; the train-run smoke
+    # stays in the fast lane (it costs a full compile)
+    "test_profiler_trace.py": None,
+    "test_obs.py": ["test_tracer_emits_valid_chrome_trace",
+                    "test_goodput_buckets_sum_to_wall",
+                    "test_sentinel_nan_halts_with_dump",
+                    "test_watchdog_detects_stall_and_recovery",
+                    "test_parse_collectives_counts_and_bytes"],
 }
 
 

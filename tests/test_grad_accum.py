@@ -44,7 +44,10 @@ def test_accum_matches_concatenated_batch(dp, tp):
     big = lambda x: x.reshape(A * B, T)
     p2, o2, l2 = step(p2, o2, big(ids), big(tgt), big(pos))
 
-    np.testing.assert_allclose(float(l1), float(l2), atol=1e-6)
+    # atol 5e-6: the accum scan and the concatenated batch reduce the same
+    # CE sum in different XLA fusion orders; f32 rounding on a ~4.3 loss
+    # wobbles a little over 1e-6 on some CPU XLA builds
+    np.testing.assert_allclose(float(l1), float(l2), atol=5e-6)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), atol=1e-6), p1, p2)
     assert int(o1.step) == int(o2.step) == 1

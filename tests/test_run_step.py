@@ -90,10 +90,12 @@ def test_timeout_kills_whole_process_group(tmp_path):
     """A step that spawns its own child (bench.py's PJRT threads analogue)
     must not leave orphans holding the single-tenant chip."""
     marker = tmp_path / "orphan_alive"
+    # the marker path rides argv, not a nested string literal — a tmpdir
+    # containing a quote character must not produce a SyntaxError child
+    inner = "import sys, time; time.sleep(5); open(sys.argv[1], 'w').write('x')"
     child = (f"import subprocess, sys, time; "
-             f"subprocess.Popen([sys.executable, '-c', "
-             f"'import time; time.sleep(5); "
-             f"open({str(marker)!r}, \"w\").write(\"x\")']); "
+             f"subprocess.Popen([sys.executable, '-c', {inner!r}, "
+             f"{str(marker)!r}]); "
              f"time.sleep(60)")
     rec, _ = run_wrapper(tmp_path, "tree-hang",
                          [sys.executable, "-c", child],
