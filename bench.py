@@ -42,7 +42,8 @@ import jax.numpy as jnp
 
 from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
                                                   Transformer, make_mesh)
-from distributed_pytorch_from_scratch_tpu.config import (REMAT_CHOICES,
+from distributed_pytorch_from_scratch_tpu.config import (IGNORE_INDEX,
+                                                         REMAT_CHOICES,
                                                          OptimizerConfig,
                                                          model_preset)
 from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
@@ -64,9 +65,13 @@ def parse_args(argv=None):
     # without remat, gpt2-355m needs "dots" (resolved post-parse). The
     # fallback ladder steps down to "dots" (matmul outputs + flash o/lse
     # residuals saved; the proven 33.7%-MFU config) and then full remat on
-    # OOM, so the artifact exists either way.
-    p.add_argument("--remat", default=None, choices=sorted(REMAT_CHOICES),
-                   help="default: false (dots for gpt2-355m)")
+    # OOM, so the artifact exists either way. "auto" picks the fastest
+    # policy whose activation-memory ESTIMATE fits the chip
+    # (training/memory.select_remat).
+    p.add_argument("--remat", default=None,
+                   choices=sorted(REMAT_CHOICES) + ["auto"],
+                   help="default: false (dots for gpt2-355m); 'auto' = "
+                        "fastest policy the memory estimate says fits")
     p.add_argument("--batch", type=int, default=None,
                    help="default: 32 (reference train.py:41), 8 for "
                         "gpt2-124m, 4 for gpt2-355m")
@@ -86,11 +91,25 @@ def parse_args(argv=None):
                    help="step-time accounting instead of a throughput "
                         "number: separately time H2D, forward, "
                         "forward+backward, the full optimizer step, and "
-                        "the scanned multi-step program, and report the "
-                        "derived bwd/adam/dispatch components (answers "
-                        "'where do the step milliseconds go'). NOTE: no "
-                        "OOM fallback ladder here — pick a fitting "
-                        "--remat/--batch")
+                        "the scanned multi-step program, report the "
+                        "derived bwd/adam/dispatch components, and emit "
+                        "the ranked roofline ATTRIBUTION table (analytic "
+                        "vs measured phase shares — answers 'where do the "
+                        "step milliseconds go'). NOTE: no OOM fallback "
+                        "ladder here — pick a fitting --remat/--batch")
+    p.add_argument("--analytic", action="store_true",
+                   help="--breakdown without any device timing: the pure "
+                        "roofline attribution report (obs/attribution), "
+                        "runnable on CPU at the flagship 45m shape in "
+                        "milliseconds")
+    p.add_argument("--seq_bucket", type=int, default=0,
+                   help="pad-aware sequence bucketing: round the sequence "
+                        "up to a multiple of N (cleanly tiled matmuls), "
+                        "tell attention the REAL length (attn_t_real — "
+                        "kernels skip the pad tiles) and mask the pad "
+                        "targets in the CE; tokens/sec and MFU count REAL "
+                        "tokens only. 0 = off. The 45m fast-path line uses "
+                        "128 (t=1000 -> 1024)")
     p.add_argument("--introspect", action="store_true",
                    help="AOT-compile the benched program once more and "
                         "print its cost analysis to stderr (XLA FLOPs vs "
@@ -110,13 +129,19 @@ def parse_args(argv=None):
     args = p.parse_args(argv)
     if args.remat is None:
         args.remat = "dots" if args.model == "gpt2-355m" else "false"
+    if args.analytic and not args.breakdown:
+        p.error("--analytic is a --breakdown mode")
+    if args.seq_bucket and (args.seq_bucket < 1 or args.seq_bucket % 128):
+        p.error(f"--seq_bucket must be a positive multiple of 128 (the TPU "
+                f"lane width), got {args.seq_bucket}")
     return args
 
 
-def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto"):
+def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto",
+                attn_t_real: int = None):
     """The one family dispatch shared by the training/decode/breakdown
     paths (three copies had already diverged once)."""
-    kw = dict(tp_size=tp, attn_impl=attn_impl)
+    kw = dict(tp_size=tp, attn_impl=attn_impl, attn_t_real=attn_t_real)
     if remat is not None:
         kw["remat"] = REMAT_CHOICES[remat]
     if args.family == "gpt2":
@@ -124,6 +149,42 @@ def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto"):
             GPT2Transformer)
         return GPT2Transformer(cfg, **kw)
     return Transformer(cfg, **kw)
+
+
+def bucket_shape(args, cfg):
+    """(t_real, t_pad): the real sequence length and the bucket-padded
+    buffer length actually dispatched (equal when bucketing is off)."""
+    t_real = args.seqlen or cfg.maxlen
+    if not args.seq_bucket:
+        return t_real, t_real
+    pad = (t_real + args.seq_bucket - 1) // args.seq_bucket * args.seq_bucket
+    return t_real, pad
+
+
+def make_batch(cfg, B, t_real, t_pad, seed=1):
+    """(ids, tgt, pos) for one step; bucket-pad rows carry IGNORE_INDEX
+    targets so the CE masks them, exactly like the train loop's bucketing."""
+    key = jax.random.key(seed)
+    ids = jax.random.randint(key, (B, t_real), 0, cfg.vocab_size)
+    tgt = jnp.roll(ids, -1, axis=1)
+    if t_pad > t_real:
+        ids = jnp.pad(ids, ((0, 0), (0, t_pad - t_real)))
+        tgt = jnp.pad(tgt, ((0, 0), (0, t_pad - t_real)),
+                      constant_values=IGNORE_INDEX)
+    pos = jnp.tile(jnp.arange(t_pad, dtype=jnp.int32)[None, :], (B, 1))
+    return ids, tgt, pos
+
+
+def chip_key() -> str:
+    """attribution's roofline key for the attached chip (v5e assumed when
+    unknown — the report labels the assumption)."""
+    from distributed_pytorch_from_scratch_tpu.obs.attribution import CHIP_SPECS
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+    kind = kind.replace("lite", "e")
+    for key in sorted(CHIP_SPECS, key=len, reverse=True):
+        if key in kind:
+            return key
+    return "v5e"
 
 
 def default_batch(args) -> int:
@@ -221,7 +282,7 @@ def run_decode_bench(args, mesh, cfg, tp: int) -> None:
 
 
 def run_breakdown(args, mesh, cfg, tp: int) -> None:
-    """Where does the step time go? (VERDICT r4 #3.)
+    """Where does the step time go? (VERDICT r4 #3 / r5 #1.)
 
     Times, with a device->host sync after each: the batch H2D transfer,
     a jitted forward (loss only), a jitted forward+backward (grads, no
@@ -229,25 +290,70 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
     steps_per_dispatch-step program. Derived components: bwd = fwdbwd-fwd,
     adam = step-fwdbwd, dispatch = step - scanned-per-step. On the
     tunneled chip `dispatch` includes the host<->device round-trip — the
-    quantity steps_per_dispatch exists to amortise."""
+    quantity steps_per_dispatch exists to amortise.
+
+    On top of the measured components, the roofline ATTRIBUTION report
+    (obs/attribution) prices every phase analytically and ranks the waste
+    suspects — pad/tile waste at the active flash blocks, remat recompute,
+    dispatch, the head — against the measured step. `--analytic` emits
+    that report alone, with no device timing at all (CPU-runnable at the
+    flagship shape)."""
     import numpy as np
+
+    from distributed_pytorch_from_scratch_tpu.obs.attribution import (
+        attribution, format_attribution)
+
     spd = max(2, args.steps_per_dispatch)
     B = default_batch(args)
-    T = args.seqlen or cfg.maxlen
+    T, T_pad = bucket_shape(args, cfg)
+    world = args.dp * tp
+
+    def emit(measured=None, comp=None):
+        report = attribution(
+            cfg, B, T_pad, remat=args.remat, spd=spd,
+            t_real=T if T_pad > T else None,
+            measured=measured, chip=chip_key(), world=world,
+            family=args.family)
+        print(format_attribution(report, measured), file=sys.stderr)
+        return report
+
+    if args.analytic:
+        report = emit()
+        shape = f"b{B}xt{T}" + (f"->t{T_pad}" if T_pad > T else "")
+        print(json.dumps({
+            "metric": (f"step-time attribution ({args.model} {args.family}, "
+                       f"{shape}, remat={args.remat}, "
+                       f"ANALYTIC {report['chip']} roofline — no device "
+                       f"timing; value = analytic step ms, vs_baseline = "
+                       f"top suspect's share of the step"),
+            "value": round(report["analytic_step_ms"], 2),
+            "unit": "ms/step (analytic)",
+            "vs_baseline": round(report["suspects"][0]["share"], 4),
+            "suspects": [{k: (round(v, 3) if isinstance(v, float) else v)
+                          for k, v in s.items()}
+                         for s in report["suspects"]],
+        }))
+        return
+
     if T > cfg.maxlen:
         # same RoPE/position-table hazard the training path fixes up: past
         # maxlen every position clips to the last row and the breakdown
         # would silently time a degenerate model
         cfg = dataclasses.replace(cfg, maxlen=T)
-    model = build_model(args, cfg, tp, remat=args.remat)
+    model = build_model(args, cfg, tp, remat=args.remat,
+                        attn_t_real=T if T_pad > T else None)
     params = jax.device_put(model.init(jax.random.key(0)),
                             model.shardings(mesh))
+    # ADVICE r5: the param-derived FLOPs count must happen BEFORE the
+    # donating step programs consume the `params` buffers below — the
+    # helper only reads `.size` metadata today, but a donated tree is one
+    # refactor away from 'Array has been deleted'
+    flops = model_flops_per_step(
+        cfg, B, T, params=params if args.family == "gpt2" else None)
     ocfg = OptimizerConfig()
-    host_ids = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, (B, T), dtype=np.int32)
-    ids = jnp.asarray(host_ids)
-    tgt = jnp.roll(ids, -1, axis=1)
-    pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (B, 1))
+    host_ids = np.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (B, T_pad), dtype=np.int32))
+    ids, tgt, pos = make_batch(cfg, B, T, T_pad)
 
     iters = args.iters
 
@@ -270,6 +376,22 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
     grad_fn = jax.jit(jax.value_and_grad(model.make_loss(mesh)))
     fwdbwd_s = timed(lambda: grad_fn(params, ids, tgt, pos),
                      lambda x: float(x[0]))
+
+    introspection = None
+    if args.introspect:
+        # cross-check the analytic FLOPs against XLA's own cost model for
+        # the fwd+bwd program (the attribution's ground-truth anchor).
+        # Runs HERE, before the donating step programs consume `params`.
+        from distributed_pytorch_from_scratch_tpu.obs import (
+            analyze_compiled, format_analysis)
+        try:
+            analysis = analyze_compiled(
+                grad_fn.lower(params, ids, tgt, pos).compile())
+            introspection = format_analysis(
+                analysis, model_flops=flops / (args.dp * tp))
+        except Exception as e:  # noqa: BLE001 — diagnostics must not kill
+            introspection = (f"unavailable: {type(e).__name__}: "
+                             f"{str(e)[:200]}")
 
     # full step programs donate params/opt_state: thread them through
     opt_state = init_adam_state(params)
@@ -307,16 +429,20 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         "derived_adam_ms": round((step_s - fwdbwd_s) * 1e3, 2),
         "derived_dispatch_ms": round((step_s - multi_s) * 1e3, 2),
     }
-    world = args.dp * tp
-    flops = model_flops_per_step(
-        cfg, B, T, params=params if args.family == "gpt2" else None)
     mfu_spd = flops / multi_s / (chip_peak_flops() * world)
-    print(f"bench[breakdown {args.model}, remat={args.remat}, b{B}xt{T}, "
+    shape_note = f"b{B}xt{T}" + (f"->t{T_pad}" if T_pad > T else "")
+    print(f"bench[breakdown {args.model}, remat={args.remat}, {shape_note}, "
           f"world={world}]: "
           + ", ".join(f"{k}={v}" for k, v in comp.items())
           + f"; MFU at spd{spd} {mfu_spd*100:.1f}%", file=sys.stderr)
+
+    if introspection is not None:
+        print(f"breakdown introspection (fwd+bwd program): {introspection}",
+              file=sys.stderr)
+
+    report = emit(measured=comp)
     print(json.dumps({
-        "metric": (f"step-time breakdown ({args.model}, bf16, b{B}xt{T}, "
+        "metric": (f"step-time breakdown ({args.model}, bf16, {shape_note}, "
                    f"remat={args.remat}; value = single-dispatch step ms, "
                    f"vs_baseline = dispatch-amortisation gain "
                    f"step_ms / step_ms_spd{spd})"),
@@ -324,6 +450,13 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         "unit": "ms/step",
         "vs_baseline": round(step_s / multi_s, 3),
         "components": comp,
+        "attribution": {
+            "analytic_step_ms": round(report["analytic_step_ms"], 2),
+            "chip": report["chip"],
+            "suspects": [{k: (round(v, 3) if isinstance(v, float) else v)
+                          for k, v in s.items()}
+                         for s in report["suspects"]],
+        },
     }))
 
 
@@ -379,12 +512,21 @@ def main(argv=None):
     tp = args.tp or max(1, n_dev // args.dp)
     mesh = make_mesh(MeshConfig(dp=args.dp, tp=tp))
     cfg = model_preset(args.model, compute_dtype="bfloat16")
+    if args.seq_bucket and cfg.num_experts:
+        raise SystemExit("--seq_bucket does not compose with MoE presets: "
+                         "the router sees every position, so pad tokens "
+                         "would claim expert-capacity slots and inflate "
+                         "the aux losses")
+    if args.remat == "auto":
+        from distributed_pytorch_from_scratch_tpu.training.memory import (
+            select_remat)
+        args.remat = select_remat(cfg, default_batch(args),
+                                  args.seqlen or cfg.maxlen,
+                                  tp=tp, world=args.dp * tp)
     if args.decode or args.breakdown:
-        if args.introspect:
-            print("bench: --introspect only applies to the default "
-                  "training bench; ignoring it for "
-                  f"--{'decode' if args.decode else 'breakdown'}",
-                  file=sys.stderr)
+        if args.introspect and args.decode:
+            print("bench: --introspect does not apply to --decode; "
+                  "ignoring it", file=sys.stderr)
         if args.decode:
             return run_decode_bench(args, mesh, cfg, tp)
         return run_breakdown(args, mesh, cfg, tp)
@@ -392,23 +534,23 @@ def main(argv=None):
     spd = max(1, args.steps_per_dispatch)
 
     B = default_batch(args)
-    T = args.seqlen or cfg.maxlen
+    T, T_pad = bucket_shape(args, cfg)
     if T > cfg.maxlen:
         # long-context bench lines (e.g. --seqlen 8192 on the 45m preset):
         # the RoPE/position tables must cover T or every position past
-        # maxlen clips to the last row (ops/rope.py clip-mode indexing)
+        # maxlen clips to the last row (ops/rope.py clip-mode indexing).
+        # Bucket padding is NOT included — pad rows are masked, so their
+        # clipped positions never matter.
         cfg = dataclasses.replace(cfg, maxlen=T)
-    key = jax.random.key(1)
-    ids = jax.random.randint(key, (B, T), 0, cfg.vocab_size)
-    tgt = jnp.roll(ids, -1, axis=1)
-    pos = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None, :], (B, 1))
+    ids, tgt, pos = make_batch(cfg, B, T, T_pad)
     if spd > 1:
         # same batch content each scanned step: throughput-identical to a
         # real stream (shapes are what matter), one H2D instead of N
         ids, tgt, pos = (jnp.tile(x[None], (spd, 1, 1)) for x in (ids, tgt, pos))
 
     def build(remat, attn_impl):
-        model = build_model(args, cfg, tp, remat=remat, attn_impl=attn_impl)
+        model = build_model(args, cfg, tp, remat=remat, attn_impl=attn_impl,
+                            attn_t_real=T if T_pad > T else None)
         params = jax.device_put(model.init(jax.random.key(0)),
                                 model.shardings(mesh))
         opt_state = init_adam_state(params)
@@ -509,10 +651,13 @@ def main(argv=None):
           + (f", tp all-reduce p50 {p50:.0f}us (4MiB)" if p50 else ""),
           file=sys.stderr)
 
+    bucket_note = (f", seq_bucket t{T}->t{T_pad} (real tokens counted)"
+                   if T_pad > T else "")
     print(json.dumps({
         "metric": (f"tokens/sec/chip ({args.model} {args.family}, bf16, b{B}xt{T}, "
                    f"dp={args.dp}, tp={tp}, remat={remat_used}, "
-                   f"attn={attn_used}, steps_per_dispatch={spd})"),
+                   f"attn={attn_used}, steps_per_dispatch={spd}"
+                   f"{bucket_note})"),
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu / 0.30, 4),

@@ -57,7 +57,7 @@ from ..parallel.moe import MoEFFN
 from ..parallel.norm import LayerNorm
 from ..runtime.prng import fold
 from .transformer import (NEG_INF, Transformer, remat_wrap,
-                          validate_cp, validate_pp)
+                          validate_cp, validate_pp, validate_t_real)
 
 Params = Dict[str, Any]
 
@@ -90,6 +90,10 @@ class GPT2Transformer:
     # (parallel/moe.py — SwiGLU experts; documented design choice, see
     # _mods). VERDICT r3 #5.
     ep_size: int = 1
+    # Pad-aware sequence bucketing — same contract as
+    # Transformer.attn_t_real (real token count inside a bucket-padded
+    # batch; attention skips the pad tiles, CE masks the pad targets).
+    attn_t_real: "int | None" = None
 
     def __post_init__(self):
         cfg, tp = self.cfg, self.tp_size
@@ -114,6 +118,7 @@ class GPT2Transformer:
         validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
         validate_pp(cfg.num_layers, self.pp_size, self.pp_microbatches,
                     self.pp_schedule, self.pp_virtual)
+        validate_t_real(self.attn_t_real, self.cp_size, cfg.num_experts)
 
     # ---- static properties ----
 
@@ -299,7 +304,8 @@ class GPT2Transformer:
                     o = ulysses_attention(q, k, v, axis="cp",
                                           impl=self.attn_impl)
             else:
-                o = causal_attention(q, k, v, impl=self.attn_impl)
+                o = causal_attention(q, k, v, impl=self.attn_impl,
+                                     t_real=self._t_real(t))
             return attn_out((x, o))
         return self._live_gated_ring(x, qkv, attn_out, pos, live)
 
@@ -382,6 +388,7 @@ class GPT2Transformer:
     def num_local_kv_heads(self) -> int:
         return self.num_local_heads  # MHA: the decoder's caches are full-size
 
+    _t_real = Transformer._t_real
     _pipeline_layers = Transformer._pipeline_layers
     _pipeline_interleaved = Transformer._pipeline_interleaved
     _pp_vary_axes = Transformer._pp_vary_axes

@@ -118,6 +118,27 @@ def validate_cp(cfg: ModelConfig, tp: int, cp_size: int, cp_impl: str,
                          "(Ulysses assumes rank-order contiguous chunks)")
 
 
+def validate_t_real(attn_t_real, cp_size: int, num_experts: int = 0) -> None:
+    """Sequence-bucketing construction checks shared by both families."""
+    if attn_t_real is None:
+        return
+    if attn_t_real < 1:
+        raise ValueError(f"attn_t_real must be >= 1, got {attn_t_real}")
+    if cp_size > 1:
+        raise ValueError(
+            "attn_t_real (pad-aware sequence bucketing) requires cp_size "
+            "== 1: the ring/ulysses paths shard the sequence over 'cp' and "
+            "mask by carried global positions, so a static real-length cut "
+            "would land mid-chunk")
+    if num_experts:
+        raise ValueError(
+            "attn_t_real (pad-aware sequence bucketing) does not compose "
+            "with MoE: the router sees every position, so pad tokens would "
+            "claim expert-capacity slots ahead of later rows' real tokens "
+            "and inflate the load-balance/z aux statistics — bucketed MoE "
+            "training would silently diverge from unbucketed")
+
+
 def remat_wrap(layer_fn, remat, static_argnums=()):
     """Apply a per-layer remat policy; shared by every model family.
 
@@ -217,6 +238,17 @@ class Transformer:
     #   False  — no remat (reference behaviour; OOMs the 45M b32xt1000 run
     #            on a 16G chip)
     remat: "bool | str" = True
+    # Pad-aware sequence bucketing: when the caller pads its (b, t) batch up
+    # to a bucket boundary (e.g. t=1000 real tokens in a t=1024 buffer so
+    # every matmul tiles cleanly on the 8x128 vector lanes AND the flash
+    # kernel's internal padding vanishes), set attn_t_real to the REAL
+    # token count. Attention then does only ~t_real work (the kernels skip
+    # fully-dead tiles and emit exact zeros/zero-grads for pad rows), and
+    # the CE loss masks the pad targets via IGNORE_INDEX as usual. None =
+    # every position is real (the default, and the only mode under cp > 1 —
+    # the ring/ulysses paths shard the sequence and carry their own
+    # position masking).
+    attn_t_real: "int | None" = None
 
     def __post_init__(self):
         cfg, tp = self.cfg, self.tp_size
@@ -242,6 +274,7 @@ class Transformer:
                              "use dp for a pure data axis)")
         validate_pp(cfg.num_layers, self.pp_size, self.pp_microbatches,
                     self.pp_schedule, self.pp_virtual)
+        validate_t_real(self.attn_t_real, self.cp_size, cfg.num_experts)
 
     # ---- sub-module definitions (static, cheap to rebuild) ----
 
@@ -513,9 +546,17 @@ class Transformer:
                     o = ulysses_attention(q, k, v, axis="cp",
                                           impl=self.attn_impl)
             else:
-                o = causal_attention(q, k, v, impl=self.attn_impl)
+                o = causal_attention(q, k, v, impl=self.attn_impl,
+                                     t_real=self._t_real(t))
             return attn_out((x, o))
         return self._live_gated_ring(x, qkv, attn_out, pos, live)
+
+    def _t_real(self, t: int) -> "int | None":
+        """attn_t_real clamped to the runtime sequence length (a shorter
+        batch than the bucket simply has no pad rows to skip)."""
+        if self.attn_t_real is None or self.attn_t_real >= t:
+            return None
+        return self.attn_t_real
 
     @property
     def _pp_vary_axes(self) -> Tuple[str, ...]:

@@ -5,6 +5,7 @@ See docs/OBSERVABILITY.md for the operator's view (trace format, goodput
 buckets, sentinel thresholds).
 """
 
+from .attribution import (attribution, flash_tile_stats, format_attribution)
 from .goodput import BUCKETS, GoodputMeter
 from .introspect import analyze_compiled, format_analysis, parse_collectives
 from .observer import TrainObserver
@@ -15,5 +16,6 @@ from .watchdog import HangWatchdog
 __all__ = [
     "BUCKETS", "GoodputMeter", "HangWatchdog", "HealthSentinel",
     "SpanTracer", "TrainObserver", "TrainingHealthError",
-    "analyze_compiled", "format_analysis", "parse_collectives",
+    "analyze_compiled", "attribution", "flash_tile_stats",
+    "format_analysis", "format_attribution", "parse_collectives",
 ]

@@ -1,0 +1,313 @@
+"""Analytic roofline + step-time attribution: where do the milliseconds go?
+
+VERDICT r5 #1: the flagship 45M config ran at 33.7% MFU while gpt2-124m hit
+55.7% on the same chip, and nothing in the repo could say WHY. This module
+answers that question without needing the chip: it prices every phase of a
+train step analytically (FLOPs and HBM bytes -> a roofline ms estimate) and
+ranks the known waste suspects — flash-kernel tile/padding waste at the
+actual block shapes, remat recompute, dispatch amortisation, the lm_head —
+so `bench.py --breakdown` can print an attribution table on CPU and
+cross-check it against measured phase times and XLA's cost_analysis when a
+backend is present.
+
+Everything here is pure host math (no jax arrays, no backend init): the
+tile accounting mirrors the flash kernels' `block_live` grid predicates
+(ops/pallas/flash_attention.py) and the phase FLOPs mirror
+`training.metrics.model_flops_per_step`'s conventions, itemised per phase.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+# Per-chip peak bf16 FLOP/s and HBM bandwidth (bytes/s). The FLOPS side
+# must agree with training.metrics.PEAK_FLOPS; bandwidth is the roofline's
+# other axis. Unknown chips assume v5e, clearly labelled in the report.
+CHIP_SPECS = {
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6e": (918e12, 1640e9),
+}
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def resolve_flash_tiling(t: int, block_q: Optional[int] = None,
+                         block_k: Optional[int] = None,
+                         head_dim: int = 64,
+                         dtype: str = "bfloat16") -> Dict[str, int]:
+    """The (t_pad, bq, bk) the flash kernel would actually run — mirrors
+    `flash_attention`'s pow2 clamp. Blocks default to the autotuner table
+    (which needs no backend for a pure lookup when the key names one)."""
+    if block_q is None or block_k is None:
+        # lazy import: the kernel module imports jax, but a table lookup
+        # does not initialise a backend beyond jax.default_backend()
+        from ..ops.pallas.flash_attention import get_block_config
+        tuned = get_block_config(t, head_dim, dtype)
+        block_q = block_q or tuned.block_q
+        block_k = block_k or tuned.block_k
+    pow2 = max(128, 1 << (t - 1).bit_length())
+    bq, bk = min(block_q, pow2), min(block_k, pow2)
+    t_pad = _round_up(t, max(bq, bk))
+    return {"t_pad": t_pad, "block_q": bq, "block_k": bk}
+
+
+def flash_tile_stats(t: int, block_q: Optional[int] = None,
+                     block_k: Optional[int] = None,
+                     t_real: Optional[int] = None,
+                     head_dim: int = 64,
+                     dtype: str = "bfloat16") -> Dict[str, float]:
+    """MXU work the fwd flash kernel performs at this (t, blocks) vs the
+    causal ideal — the quantified 't=1000 -> 1024 padding waste' suspect.
+
+    Counts live (q-block, k-block) tiles with the kernel's own
+    `block_live` predicate; work = live tiles x bq x bk score elements.
+    `waste_ratio` = work / ideal (1.0 = perfect causal skip; the shipped
+    1024x1024 default at t=1000 computes the FULL padded square = ~2.1x).
+    `t_real` < t prices the pad-aware bucketed path (attn_t_real).
+    """
+    tiling = resolve_flash_tiling(t, block_q, block_k, head_dim, dtype)
+    t_pad, bq, bk = tiling["t_pad"], tiling["block_q"], tiling["block_k"]
+    tr = t if t_real is None else t_real
+    num_qb, num_kb = t_pad // bq, t_pad // bk
+    live = 0
+    for qi in range(num_qb):
+        for ki in range(num_kb):
+            if (ki * bk <= qi * bq + bq - 1 and ki * bk < tr
+                    and qi * bq < tr):
+                live += 1
+    work = live * bq * bk
+    ideal = tr * (tr + 1) / 2
+    return {"t_pad": t_pad, "block_q": bq, "block_k": bk,
+            "live_tiles": live, "total_tiles": num_qb * num_kb,
+            "work_elems": work, "ideal_elems": ideal,
+            "waste_ratio": work / ideal}
+
+
+@dataclasses.dataclass
+class PhaseCost:
+    """One phase's analytic price. ms_est = roofline max(compute, memory)."""
+
+    name: str
+    flops: float
+    bytes: float
+    note: str = ""
+
+    def ms(self, peak_flops: float, hbm_bw: float) -> float:
+        return max(self.flops / peak_flops, self.bytes / hbm_bw) * 1e3
+
+
+def analytic_phases(cfg, batch: int, t: int, remat: str = "dots",
+                    t_real: Optional[int] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    family: str = "llama") -> List[PhaseCost]:
+    """Per-phase FLOPs + HBM bytes for ONE fwd+bwd+adam train step (global,
+    all devices), itemised so shares can be compared against measured
+    fwd/bwd/adam times. remat in {'false','dots','true'} (CLI strings)."""
+    d, f, L = cfg.attn_dim, cfg.ffn_dim, cfg.num_layers
+    h, hd, kd = cfg.num_heads, cfg.head_dim, cfg.kv_dim
+    v = cfg.padded_vocab_size(1)
+    N = batch * t            # tokens incl. any bucket padding
+    A = 2                    # activation bytes (bf16); f32 would be 4
+    P = cfg.num_params()
+    # llama: SwiGLU = gate/up/down, 3 matmuls; gpt2: fc/proj gelu MLP, 2
+    ffn_mats = 2 if family == "gpt2" else 3
+
+    stats = flash_tile_stats(t, block_q, block_k, t_real, hd,
+                             cfg.compute_dtype)
+    attn_elems = batch * h * stats["work_elems"]
+
+    fwd = [
+        PhaseCost("embed", 0.0, N * d * 4 + N * 4,
+                  "gather; bytes-bound"),
+        PhaseCost("qkv_proj", L * 2 * N * d * (d + 2 * kd),
+                  L * (N * (d + (d + 2 * kd)) * A + d * (d + 2 * kd) * A)),
+        PhaseCost("attention", attn_elems * 4 * hd,
+                  L * (N * (2 * d + 2 * kd) * A + N * h * 4),
+                  f"{stats['live_tiles']}/{stats['total_tiles']} live "
+                  f"{stats['block_q']}x{stats['block_k']} tiles, "
+                  f"{stats['waste_ratio']:.2f}x causal-ideal work"),
+        PhaseCost("wo_proj", L * 2 * N * d * d,
+                  L * (2 * N * d * A + d * d * A)),
+        PhaseCost("ffn", L * 2 * ffn_mats * N * d * f,
+                  L * (2 * N * (d + (ffn_mats - 1) * f) * A
+                       + ffn_mats * d * f * A)),
+        PhaseCost("norms_rope", L * 16 * N * d, L * 6 * N * d * A,
+                  "elementwise; bytes-bound"),
+        PhaseCost("lm_head", 2 * N * d * v, N * d * A + N * v * 4),
+        PhaseCost("ce_loss", 8 * N * v, 2 * N * v * 4,
+                  "f32 logits read+reduce"),
+    ]
+    # attention FLOPs scale by L too (itemised per layer above except attn)
+    fwd[2] = dataclasses.replace(fwd[2], flops=fwd[2].flops * L)
+
+    # Backward: matmul phases cost 2x forward (dgrad + wgrad); the flash
+    # backward runs 5 MXU dots where the forward runs 2 (fused path) ->
+    # 2.5x; elementwise ~2x. Remat adds recompute on top:
+    #   'true' — the whole layer forward replays (+1x layer fwd FLOPs)
+    #   'dots' — matmul outputs + flash o/lse are saved; only elementwise
+    #            replays (norms/rope/silu)
+    #   'false' — nothing replays
+    layer_fwd_flops = sum(p.flops for p in fwd[1:6])
+    layer_fwd_bytes = sum(p.bytes for p in fwd[1:6])
+    recompute = {"true": layer_fwd_flops,
+                 "dots": fwd[5].flops,
+                 "false": 0.0}[str(remat)]
+    recompute_bytes = (layer_fwd_bytes * recompute / layer_fwd_flops
+                       if layer_fwd_flops else 0.0)
+    bwd_flops = (2 * (fwd[1].flops + fwd[3].flops + fwd[4].flops
+                      + fwd[6].flops + fwd[7].flops)
+                 + 2.5 * fwd[2].flops + 2 * fwd[5].flops)
+    bwd_bytes = 2 * sum(p.bytes for p in fwd[1:])
+    phases = fwd + [
+        PhaseCost("backward", bwd_flops, bwd_bytes,
+                  "2x matmuls, 2.5x flash kernel"),
+        PhaseCost("remat_recompute", recompute, recompute_bytes,
+                  f"remat={remat}"),
+        PhaseCost("adam", 12 * P, 28 * P,
+                  "f32 params/moments read+write; bytes-bound"),
+    ]
+    return phases
+
+
+def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
+                t_real: Optional[int] = None,
+                block_q: Optional[int] = None,
+                block_k: Optional[int] = None,
+                measured: Optional[Dict[str, float]] = None,
+                chip: str = "v5e", world: int = 1,
+                family: str = "llama") -> Dict:
+    """The full report structure: analytic phase table, fwd/bwd/adam bucket
+    sums, ranked waste suspects, and (when `measured` carries bench.py
+    --breakdown components) analytic-vs-measured share columns.
+
+    measured keys (all optional, ms): fwd_ms, fwdbwd_ms, step_ms,
+    h2d_ms, and any 'step_ms_spdN'.
+    """
+    peak_flops, hbm_bw = CHIP_SPECS.get(chip, CHIP_SPECS["v5e"])
+    peak_flops *= world
+    hbm_bw *= world
+    phases = analytic_phases(cfg, batch, t, remat, t_real, block_q, block_k,
+                             family)
+    by = {p.name: p for p in phases}
+    ms = {p.name: p.ms(peak_flops, hbm_bw) for p in phases}
+    fwd_names = ["embed", "qkv_proj", "attention", "wo_proj", "ffn",
+                 "norms_rope", "lm_head", "ce_loss"]
+    buckets = {
+        "fwd_ms": sum(ms[n] for n in fwd_names),
+        "bwd_ms": ms["backward"] + ms["remat_recompute"],
+        "adam_ms": ms["adam"],
+    }
+    analytic_step = sum(buckets.values())
+
+    measured = measured or {}
+    spd_keys = [k for k in measured if k.startswith("step_ms_spd")]
+    measured_amortised = measured.get(spd_keys[0]) if spd_keys else None
+    measured_step = measured.get("step_ms")
+    dispatch_ms = (measured_step - measured_amortised
+                   if measured_step and measured_amortised else None)
+    # the yardstick every suspect's share is quoted against
+    step_ms = measured_amortised or measured_step or analytic_step
+
+    stats = flash_tile_stats(t, block_q, block_k, t_real, cfg.head_dim,
+                             cfg.compute_dtype)
+    attn_ms = ms["attention"] * (1 + 2.5)  # fwd + its share of backward
+    waste = stats["waste_ratio"]
+    suspects = [{
+        "name": "attention tile/pad waste",
+        "est_ms": attn_ms * (1 - 1 / waste),
+        "note": (f"t={t_real or t}->t_pad {stats['t_pad']} @ "
+                 f"{stats['block_q']}x{stats['block_k']} blocks: "
+                 f"{waste:.2f}x causal-ideal MXU work (fix: bucketing/"
+                 f"attn_t_real + tuned blocks)"),
+    }, {
+        "name": "remat recompute",
+        "est_ms": ms["remat_recompute"],
+        "note": f"remat={remat} (fix: --remat auto picks false when "
+                f"activations fit)",
+    }, {
+        "name": "dispatch overhead",
+        "est_ms": dispatch_ms if dispatch_ms is not None else 0.0,
+        "note": (f"measured step - spd-amortised step at spd={spd}"
+                 if dispatch_ms is not None else
+                 f"unmeasured (needs --breakdown on a backend); spd={spd} "
+                 f"amortises host round-trips"),
+    }, {
+        "name": "lm_head+CE (vocab %d)" % cfg.vocab_size,
+        "est_ms": ms["lm_head"] + ms["ce_loss"],
+        "note": "unsharded head pass + f32 CE over the full vocab",
+    }, {
+        "name": "optimizer (bytes-bound)",
+        "est_ms": ms["adam"],
+        "note": "28 bytes/param HBM traffic",
+    }]
+    if step_ms > analytic_step:
+        # The most important row when a measurement exists: whatever the
+        # itemised suspects do NOT cover. A large value here means the gap
+        # is kernel efficiency / launch overhead / pipeline stalls — small
+        # matmuls far off peak — not algorithmic waste; --breakdown's
+        # fwd/bwd/adam splits localise which phase is off its roofline.
+        gap = step_ms - analytic_step - (dispatch_ms or 0.0)
+        if gap > 0:
+            suspects.append({
+                "name": "roofline gap (kernel efficiency)",
+                "est_ms": gap,
+                "note": ("measured minus analytic roofline: time the "
+                         "itemised suspects cannot explain — small-matmul "
+                         "MXU underutilisation and per-kernel overhead at "
+                         f"d={cfg.attn_dim}"),
+            })
+    suspects.sort(key=lambda s: -s["est_ms"])
+    for rank, s in enumerate(suspects, 1):
+        s["rank"] = rank
+        s["share"] = s["est_ms"] / step_ms if step_ms else 0.0
+
+    return {"phases": [dataclasses.asdict(p) | {"ms_est": ms[p.name]}
+                       for p in phases],
+            "buckets": buckets,
+            "analytic_step_ms": analytic_step,
+            "measured_step_ms": measured_step,
+            "measured_amortised_ms": measured_amortised,
+            "dispatch_ms": dispatch_ms,
+            "step_ms_basis": step_ms,
+            "tile_stats": stats,
+            "suspects": suspects,
+            "chip": chip, "world": world,
+            "assumptions": (f"{chip} roofline ({peak_flops/1e12:.0f} "
+                            f"TFLOP/s, {hbm_bw/1e9:.0f} GB/s) x {world} "
+                            f"device(s); bf16 activations, f32 optimizer")}
+
+
+def format_attribution(report: Dict,
+                       measured: Optional[Dict[str, float]] = None) -> str:
+    """Human table: ranked suspects + analytic-vs-measured bucket shares."""
+    lines = ["step-time attribution (" + report["assumptions"] + ")"]
+    basis = report["step_ms_basis"]
+    src = ("measured" if report.get("measured_amortised_ms")
+           or report.get("measured_step_ms") else "analytic")
+    lines.append(f"  step basis: {basis:.1f} ms ({src})")
+
+    measured = measured or {}
+    mfwd = measured.get("fwd_ms")
+    mbwd = (measured["fwdbwd_ms"] - measured["fwd_ms"]
+            if "fwdbwd_ms" in measured and "fwd_ms" in measured else None)
+    madam = (measured["step_ms"] - measured["fwdbwd_ms"]
+             if "step_ms" in measured and "fwdbwd_ms" in measured else None)
+    b = report["buckets"]
+    lines.append("  bucket       analytic_ms   measured_ms")
+    for name, analytic, meas in [("fwd", b["fwd_ms"], mfwd),
+                                 ("bwd(+remat)", b["bwd_ms"], mbwd),
+                                 ("adam", b["adam_ms"], madam)]:
+        m = f"{meas:11.2f}" if meas is not None else "          —"
+        lines.append(f"  {name:<12} {analytic:11.2f}   {m}")
+
+    lines.append("  rank  suspect                        est_ms  share  note")
+    for s in report["suspects"]:
+        lines.append(f"  {s['rank']:>4}  {s['name']:<29} {s['est_ms']:7.2f}"
+                     f"  {s['share']*100:4.1f}%  {s['note']}")
+    return "\n".join(lines)

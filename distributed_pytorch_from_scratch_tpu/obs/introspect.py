@@ -93,7 +93,7 @@ def analyze_compiled(compiled) -> dict:
     """Best-effort {flops, bytes_accessed, peak_hbm_bytes, collectives,
     comm_bytes} for one compiled executable."""
     out = {"flops": None, "bytes_accessed": None, "peak_hbm_bytes": None,
-           "collectives": {}, "comm_bytes": 0}
+           "alias_bytes": None, "collectives": {}, "comm_bytes": 0}
     cost = _cost_dict(compiled)
     if cost:
         flops = cost.get("flops")
@@ -107,6 +107,11 @@ def analyze_compiled(compiled) -> dict:
             + getattr(ma, "output_size_in_bytes", 0)
             + getattr(ma, "temp_size_in_bytes", 0)
             + getattr(ma, "alias_size_in_bytes", 0))
+        # donation hygiene: the bytes the donated params/opt state actually
+        # aliased in-place. A train-step program reporting ~0 here means a
+        # refactor broke the donation (e.g. a dtype change) and the
+        # optimizer state silently doubled its footprint.
+        out["alias_bytes"] = int(getattr(ma, "alias_size_in_bytes", 0))
     except Exception:
         pass
     try:
@@ -133,6 +138,9 @@ def format_analysis(a: dict, model_flops: Optional[float] = None,
         parts.append(f"{a['bytes_accessed'] / gib:.2f} GiB accessed")
     if a.get("peak_hbm_bytes"):
         parts.append(f"peak HBM {a['peak_hbm_bytes'] / gib:.2f} GiB")
+    if a.get("alias_bytes") is not None:
+        parts.append(f"donated/aliased {a['alias_bytes'] / gib:.2f} GiB "
+                     f"in-place")
     if a.get("collectives"):
         comm = ", ".join(
             f"{op} x{c['count']} ({c['bytes'] / 2 ** 20:.1f} MiB)"

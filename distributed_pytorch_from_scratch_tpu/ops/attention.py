@@ -29,11 +29,21 @@ def repeat_kv(q: jax.Array, k: jax.Array, v: jax.Array):
     return k, v
 
 
-def causal_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+def causal_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array,
+                         t_real: int = None) -> jax.Array:
     """q: (b, heads, t, head_dim) -> (b, heads, t, head_dim); k/v may carry
     fewer (grouped-query) heads — expanded here (the flash kernel instead
-    routes blocks, ops/pallas/flash_attention.py)."""
+    routes blocks, ops/pallas/flash_attention.py).
+
+    `t_real` < t marks the trailing rows as padding (sequence bucketing):
+    they are sliced off before the O(t^2) score tensor forms and the output
+    pads back with exact zeros — the same contract as the flash kernel's
+    `t_real`, so the two impls stay interchangeable."""
     *_, t, head_dim = q.shape
+    if t_real is not None and t_real < t:
+        out = causal_attention_xla(q[..., :t_real, :], k[..., :t_real, :],
+                                   v[..., :t_real, :])
+        return jnp.pad(out, ((0, 0), (0, 0), (0, t - t_real), (0, 0)))
     k, v = repeat_kv(q, k, v)
     scale = 1.0 / math.sqrt(head_dim)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -43,14 +53,15 @@ def causal_attention_xla(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "auto") -> jax.Array:
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     impl: str = "auto", t_real: int = None) -> jax.Array:
     if impl == "auto":
         # Pallas flash on real TPU (1.5x faster fwd+bwd at reference scale,
         # takes the 45M b32xt1000 train step from 25.9% to 30.0% MFU on v5e);
         # on CPU the kernel only runs interpreted (slow), so use XLA there.
         impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
-        return causal_attention_xla(q, k, v)
+        return causal_attention_xla(q, k, v, t_real=t_real)
     if impl == "flash":
         try:
             from .pallas.flash_attention import flash_attention
@@ -58,5 +69,6 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array, impl: str = "auto
             raise NotImplementedError(
                 "the Pallas flash-attention kernel is not available in this "
                 "build; use impl='xla'") from e
-        return flash_attention(q, k, v)
+        # block sizes come from the autotuner table (get_block_config)
+        return flash_attention(q, k, v, t_real=t_real)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -25,12 +25,24 @@ route query-head row `b*hq + h` to kv row `b*hkv + h // group` — no
 saving survives training, not just decode. The dk/dv backward accumulates
 over the `group` query heads of each kv head through an extra sequential
 grid dimension.
+
+Round 6 (the 45M MFU-gap work): block shapes default to a cached
+autotuner table (`get_block_config` / `autotune_block_config` — the best
+combo flips between shapes, see DEFAULT_BLOCK_Q's sweep note), and the
+public `t_real` argument makes the kernels pad-aware for sequence
+bucketing: a t=1024 buffer holding 1000 real tokens does ~1000 tokens of
+work (dead tiles are skipped by the same grid guards as the internal
+padding), with exact zeros and exact zero gradients on the pad rows.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import json
 import math
+import os
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -104,13 +116,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             jnp.int32, (block_q, block_k), 0)
         col = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where((col > row) | (col >= t_real), MASK, s)
+        # row >= t_real: dead (padding) query rows emit o = 0 / lse = MASK —
+        # the invariant the backward kernels' dead-row guards rely on, and
+        # the public t_real contract (pad rows are exact zeros).
+        s = jnp.where((col > row) | (col >= t_real) | (row >= t_real),
+                      MASK, s)
 
         m_prev = m_ref[:]                                    # (bq, 1)
         l_prev = l_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)                      # (bq, 1)
-        p = jnp.exp(s - m_new)                               # (bq, bk)
+        # clamp: all-dead rows (>= t_real) keep m_new = MASK, and
+        # exp(MASK - MASK) = 1 would resurrect masked entries (the same
+        # guard _pos_fwd_kernel carries); live rows have m_new > MASK/2
+        # and are unaffected
+        m_safe = jnp.maximum(m_new, MASK / 2)
+        alpha = jnp.exp(m_prev - m_safe)                     # (bq, 1)
+        p = jnp.exp(s - m_safe)                              # (bq, bk)
         l_ref[:] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         m_ref[:] = m_new
         pv = jax.lax.dot_general(
@@ -213,8 +234,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             jnp.int32, (block_q, block_k), 0)
         col = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where((col > row) | (col >= t_real), MASK, s)
-        p = jnp.exp(s - lse_ref[0])                          # (bq, bk)
+        live = (col <= row) & (col < t_real) & (row < t_real)
+        s = jnp.where(live, s, MASK)
+        # hard-zero masked entries: dead rows (>= t_real) carry lse = MASK,
+        # and exp(MASK - MASK) = 1 would fabricate p there — harmless only
+        # while their cotangents are exactly zero, which the public t_real
+        # path must not rely on (e.g. MoE aux losses touch every row)
+        p = jnp.where(live, jnp.exp(s - lse_ref[0]), 0.0)    # (bq, bk)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0],
             (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
@@ -256,9 +282,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             jnp.int32, (block_k, block_q), 0)
         row = qi * block_q + jax.lax.broadcasted_iota(    # query index
             jnp.int32, (block_k, block_q), 1)
-        st = jnp.where((col > row) | (col >= t_real) | (row >= t_real),
-                       MASK, st)
-        pt = jnp.exp(st - jnp.transpose(lse_ref[0]))         # (bk, bq)
+        live_t = (col <= row) & (col < t_real) & (row < t_real)
+        st = jnp.where(live_t, st, MASK)
+        # hard-zero like _dq_kernel: dead rows' lse = MASK fabricates p = 1
+        pt = jnp.where(live_t, jnp.exp(st - jnp.transpose(lse_ref[0])),
+                       0.0)                                  # (bk, bq)
         dv_acc[:] += jax.lax.dot_general(
             pt.astype(do_ref.dtype), do_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -296,7 +324,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, (t_pad, t_pad), 1)
     live = (col <= row) & (col < t_real) & (row < t_real)
     s = jnp.where(live, s, MASK)
-    p = jnp.exp(s - lse_ref[...])                            # (t, t) f32
+    # hard-zero dead rows (lse = MASK there; see _dq_kernel)
+    p = jnp.where(live, jnp.exp(s - lse_ref[...]), 0.0)      # (t, t) f32
     # dv[kt, d] = sum_qt p[qt, kt] * do[qt, d]
     dv_ref[...] = jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -334,7 +363,8 @@ def _bwd_fused_gqa_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     col = jax.lax.broadcasted_iota(jnp.int32, (t_pad, t_pad), 1)
     live = (col <= row) & (col < t_real) & (row < t_real)
     s = jnp.where(live, s, MASK)
-    p = jnp.exp(s - lse_ref[...])                            # (t, t) f32
+    # hard-zero dead rows (lse = MASK there; see _dq_kernel)
+    p = jnp.where(live, jnp.exp(s - lse_ref[...]), 0.0)      # (t, t) f32
     dv_acc[:] += jax.lax.dot_general(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
@@ -472,14 +502,190 @@ def _bwd_call(q, k, v, o, lse, do, *, t_real: int, block_q: int, block_k: int,
     return dq, dk, dv
 
 
+# ------------------------------------------- block-shape autotuner table
+#
+# The best (block_q, block_k, bwd_block_q, bwd_block_k) combo depends on
+# (padded seqlen, head_dim, dtype, backend) — at the reference shape the
+# grid-overhead-vs-causal-skip trade-off even inverts between block sizes
+# (see DEFAULT_BLOCK_Q's sweep note). Rather than bake one answer in, the
+# kernel consults a small cached table: built-in entries ship the swept
+# defaults, `autotune_block_config` measures and caches the best combo for
+# a new shape, and the cache persists as JSON (FLASH_BLOCKS_CACHE or
+# ~/.cache/dpfs_tpu/flash_blocks.json) so a sweep done once on hardware
+# (scripts/tune_flash_blocks.py --write_cache) serves every later run.
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockConfig:
+    """One (fwd, bwd) block-shape choice for the flash kernels."""
+
+    block_q: int = DEFAULT_BLOCK_Q
+    block_k: int = DEFAULT_BLOCK_K
+    bwd_block_q: int = DEFAULT_BWD_BLOCK_Q
+    bwd_block_k: int = DEFAULT_BWD_BLOCK_K
+
+    def as_tuple(self) -> Tuple[int, int, int, int]:
+        return (self.block_q, self.block_k, self.bwd_block_q,
+                self.bwd_block_k)
+
+
+# (t_bucket, head_dim, dtype_name, backend) -> BlockConfig. t buckets by the
+# next power of two (the padded length the kernel actually runs), so t=1000
+# and t=1024 share one tuned entry. Built-in seed: the v5e sweep behind the
+# DEFAULT_* constants (b*h=256, t→1024, hd=64, bf16).
+_BLOCK_TABLE: Dict[Tuple[int, int, str, str], BlockConfig] = {
+    (1024, 64, "bfloat16", "tpu"): BlockConfig(1024, 1024, 1024, 1024),
+}
+_cache_loaded = False
+
+
+def block_cache_path() -> str:
+    return os.environ.get(
+        "FLASH_BLOCKS_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "dpfs_tpu",
+                     "flash_blocks.json"))
+
+
+def _table_key(t: int, head_dim: int, dtype) -> Tuple[int, int, str, str]:
+    t_bucket = max(128, 1 << (int(t) - 1).bit_length())
+    return (t_bucket, int(head_dim), jnp.dtype(dtype).name,
+            jax.default_backend())
+
+
+def load_block_cache(path: Optional[str] = None) -> int:
+    """Merge the JSON cache into the in-memory table; returns entries read.
+    Unreadable/garbled files are ignored (the table still has defaults)."""
+    path = path or block_cache_path()
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    n = 0
+    for key, blocks in raw.items():
+        try:
+            t_bucket, hd, dtype_name, backend = key.split(":")
+            cfg = BlockConfig(*(int(b) for b in blocks))
+        except (ValueError, TypeError):
+            continue  # skip malformed entries, keep the rest
+        _BLOCK_TABLE[(int(t_bucket), int(hd), dtype_name, backend)] = cfg
+        n += 1
+    return n
+
+
+def save_block_cache(path: Optional[str] = None) -> str:
+    path = path or block_cache_path()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    raw = {":".join(str(p) for p in key): list(cfg.as_tuple())
+           for key, cfg in sorted(_BLOCK_TABLE.items())}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(raw, f, indent=1)
+    os.replace(tmp, path)  # atomic publish, like training/checkpoint.py
+    return path
+
+
+def set_block_config(t: int, head_dim: int, dtype,
+                     config: BlockConfig) -> None:
+    _BLOCK_TABLE[_table_key(t, head_dim, dtype)] = config
+
+
+def get_block_config(t: int, head_dim: int, dtype) -> BlockConfig:
+    """Tuned blocks for this (t, head_dim, dtype) on the current backend,
+    falling back to the swept DEFAULT_* values. Loads the JSON cache once
+    per process."""
+    global _cache_loaded
+    if not _cache_loaded:
+        _cache_loaded = True
+        load_block_cache()
+    return _BLOCK_TABLE.get(_table_key(t, head_dim, dtype), BlockConfig())
+
+
+def autotune_block_config(t: int, head_dim: int, dtype=jnp.bfloat16,
+                          batch_heads: int = 8,
+                          sweep: Tuple[int, ...] = (128, 256, 512),
+                          iters: int = 5, warmup: int = 2,
+                          include_current: bool = True,
+                          write_cache: bool = False) -> BlockConfig:
+    """Sweep block_q x block_k over `sweep` for this (t, head_dim, dtype),
+    time fwd and fwd+bwd on the CURRENT backend, record the best combo in
+    the table (and optionally the JSON cache). Returns the winner.
+
+    The fwd combo is chosen first; the bwd blocks are then swept with the
+    winning fwd blocks fixed (they run as separate kernels with separate
+    VMEM working sets, so the product factorises). Combos that clamp to an
+    identical effective shape (blocks > padded t) dedupe before timing.
+    """
+    import time
+
+    key = jax.random.key(0)
+    shape = (1, batch_heads, t, head_dim)
+    q = jax.random.normal(jax.random.fold_in(key, 1), shape, dtype)
+    k = jax.random.normal(jax.random.fold_in(key, 2), shape, dtype)
+    v = jax.random.normal(jax.random.fold_in(key, 3), shape, dtype)
+
+    pow2 = max(128, 1 << (t - 1).bit_length())
+    candidates = sorted(set(
+        (min(bq, pow2), min(bk, pow2)) for bq in sweep for bk in sweep))
+    if include_current:
+        cur = get_block_config(t, head_dim, dtype)
+        candidates = sorted(set(
+            candidates + [(min(cur.block_q, pow2), min(cur.block_k, pow2))]))
+
+    def timed(fn) -> float:
+        for _ in range(warmup):
+            jax.block_until_ready(fn(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(q, k, v)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    def sweep_over(pairs, build):
+        best = None
+        for pair in pairs:
+            try:
+                secs = timed(build(pair))
+            except Exception:  # noqa: BLE001 — an invalid combo just loses
+                continue
+            if best is None or secs < best[0]:
+                best = (secs, pair)
+        if best is None:
+            raise RuntimeError(
+                f"flash block autotune: every candidate failed at "
+                f"t={t} hd={head_dim} {jnp.dtype(dtype).name}")
+        return best[1]
+
+    fwd_bq, fwd_bk = sweep_over(candidates, lambda pair: jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, block_q=pair[0],
+                                        block_k=pair[1])))
+
+    def grad_fn(pair):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, block_q=fwd_bq, block_k=fwd_bk,
+                bwd_block_q=pair[0], bwd_block_k=pair[1]
+            ).astype(jnp.float32) ** 2)
+        return jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+
+    bwd_bq, bwd_bk = sweep_over(candidates, grad_fn)
+
+    best = BlockConfig(fwd_bq, fwd_bk, bwd_bq, bwd_bk)
+    set_block_config(t, head_dim, dtype, best)
+    if write_cache:
+        save_block_cache()
+    return best
+
+
 # ---------------------------------------------------------------- public
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: int = None,
+                    block_k: int = None,
                     bwd_block_q: int = None,
-                    bwd_block_k: int = None) -> jax.Array:
+                    bwd_block_k: int = None,
+                    t_real: int = None) -> jax.Array:
     """Causal flash attention. q: (b, heads, t, head_dim); k, v may carry
     FEWER heads (b, kv_heads, t, head_dim) with heads % kv_heads == 0 —
     grouped-query attention routed inside the kernels (no K/V repeat in HBM).
@@ -487,18 +693,32 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Drop-in replacement for `causal_attention_xla`
     (`/root/reference/models/model.py:73-77` semantics). Sequence length is
     padded to the block size internally; padded keys are masked, padded
-    query rows are sliced off. `bwd_block_*` tune the dq/dkv kernels
-    independently of the forward (default: the swept DEFAULT_BWD_* values).
+    query rows are sliced off. Block sizes default to the autotuner table
+    (`get_block_config`; explicit values override); `bwd_block_*` tune the
+    dq/dkv kernels independently of the forward.
+
+    `t_real` (pad-aware bucketing): when the caller's sequence buffer is
+    itself padded — e.g. t=1000 real tokens bucketed into a t=1024 buffer
+    so every surrounding matmul tiles cleanly — pass the real length and
+    the kernels do only ~t_real work (block-granular: fully-dead tiles are
+    skipped by the grid guards, exactly like the internal padding). Rows
+    >= t_real read as zeros and emit exact zero gradients.
     """
     b, h, t, d = q.shape
     hkv = k.shape[1]
     if h % hkv or v.shape[1] != hkv:
         raise ValueError(f"q heads {h} must be a multiple of kv heads "
                          f"{k.shape[1]}/{v.shape[1]}")
-    if bwd_block_q is None:
-        bwd_block_q = DEFAULT_BWD_BLOCK_Q
-    if bwd_block_k is None:
-        bwd_block_k = DEFAULT_BWD_BLOCK_K
+    if t_real is None:
+        t_real = t
+    elif not 1 <= t_real <= t:
+        raise ValueError(f"t_real {t_real} must be in [1, t={t}]")
+    if None in (block_q, block_k, bwd_block_q, bwd_block_k):
+        tuned = get_block_config(t, d, q.dtype)
+        block_q = block_q or tuned.block_q
+        block_k = block_k or tuned.block_k
+        bwd_block_q = bwd_block_q or tuned.bwd_block_q
+        bwd_block_k = bwd_block_k or tuned.bwd_block_k
     for name, blk in (("block_q", block_q), ("block_k", block_k),
                       ("bwd_block_q", bwd_block_q),
                       ("bwd_block_k", bwd_block_k)):
@@ -524,7 +744,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             x = jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0)))
         return x
 
-    o = _flash_with_t(prep(q, h), prep(k, hkv), prep(v, hkv), t,
+    o = _flash_with_t(prep(q, h), prep(k, hkv), prep(v, hkv), t_real,
                       bq, bk, bbq, bbk, h, hkv)
     return o[:, :t, :].reshape(b, h, t, d)
 

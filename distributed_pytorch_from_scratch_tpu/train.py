@@ -181,11 +181,22 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--moe_capacity_factor", type=float, default=None,
                    help="per-expert slot headroom; overflow tokens fall "
                         "through the residual (default 2.0)")
-    g.add_argument("--remat", choices=sorted(REMAT_CHOICES),
+    g.add_argument("--remat", choices=sorted(REMAT_CHOICES) + ["auto"],
                    default="true",
                    help="per-layer rematerialisation: 'true' = lowest "
                         "memory, 'dots' = fastest that still bounds "
-                        "residuals (see models/transformer.py)")
+                        "residuals (see models/transformer.py); 'auto' = "
+                        "the fastest policy whose activation-memory "
+                        "estimate fits the chip "
+                        "(training/memory.select_remat)")
+    g.add_argument("--seq_bucket", type=int, default=0,
+                   help="pad-aware sequence bucketing: pad each batch's "
+                        "sequence dim up to a multiple of N (cleanly "
+                        "tiled matmuls; 128 = the TPU lane width), tell "
+                        "attention the real maxlen (pad tiles are "
+                        "skipped, attn_t_real) and mask the pad targets "
+                        "in the CE (IGNORE_INDEX). 0 = off; needs "
+                        "--cp_size 1")
 
     g = p.add_argument_group("data")
     g.add_argument("--data_path", "-d", type=str, required=True)
@@ -235,6 +246,26 @@ def get_train_args(argv=None) -> argparse.Namespace:
     g.add_argument("--process_id", type=int, default=None,
                    help="multi-host: this process's id (see --num_processes)")
     return p.parse_args(argv)
+
+
+def _bucket_window(window: dict, t_pad: int) -> dict:
+    """Pad a host batch window's sequence dim up to `t_pad` (sequence
+    bucketing): ids pad with 0 (any valid token — masked), targets with
+    IGNORE_INDEX (the CE mask), positions extend edge-wise (clipped by the
+    rope table, and masked anyway). Works on (B, T) and stacked (N, B, T)
+    windows alike."""
+    def pad(a, fill=None):
+        extra = t_pad - a.shape[-1]
+        if extra <= 0:
+            return a
+        width = [(0, 0)] * (a.ndim - 1) + [(0, extra)]
+        if fill is None:
+            return np.pad(a, width, mode="edge")
+        return np.pad(a, width, constant_values=fill)
+
+    return {"input_ids": pad(window["input_ids"], 0),
+            "target_ids": pad(window["target_ids"], IGNORE_INDEX),
+            "position_ids": pad(window["position_ids"])}
 
 
 class _ShutdownFlag:
@@ -340,6 +371,36 @@ def train(args: argparse.Namespace) -> dict:
                                                    preset.moe_capacity_factor),
                           vocab_size=vocab_size, maxlen=maxlen,
                           compute_dtype="bfloat16" if args.bf16 else "float32")
+        remat_key = args.remat
+        if remat_key == "auto":
+            from .training.memory import select_remat
+            remat_key = select_remat(cfg, args.batch_size, maxlen,
+                                     tp=args.tp_size,
+                                     world=mesh_cfg.world_size)
+        t_bucket = 0
+        if args.seq_bucket:
+            if args.seq_bucket < 1 or args.seq_bucket % 128:
+                raise SystemExit(
+                    f"--seq_bucket must be a positive multiple of 128 (the "
+                    f"TPU lane width), got {args.seq_bucket}")
+            if args.cp_size > 1:
+                raise SystemExit("--seq_bucket needs --cp_size 1 (the "
+                                 "ring/ulysses paths shard the sequence "
+                                 "and mask by global positions)")
+            if cfg.num_experts:
+                raise SystemExit(
+                    "--seq_bucket does not compose with MoE: the router "
+                    "sees every position, so pad tokens would claim "
+                    "expert-capacity slots and inflate the aux losses")
+            t_bucket = (-(-maxlen // args.seq_bucket)) * args.seq_bucket
+            if t_bucket == maxlen:
+                t_bucket = 0  # already aligned: nothing to pad
+            else:
+                print(f"seq bucketing: dispatching t={maxlen} batches in "
+                      f"t={t_bucket} buffers (attention skips the pad "
+                      f"tiles; CE masks the pad targets; tok/s and MFU "
+                      f"count real tokens)")
+        attn_t_real = maxlen if t_bucket else None
         if args.family == "gpt2":
             from .models.gpt2 import GPT2Transformer
             model = GPT2Transformer(cfg, tp_size=args.tp_size,
@@ -351,7 +412,8 @@ def train(args: argparse.Namespace) -> dict:
                                     pp_remat_steps=args.pp_remat_steps,
                                     pp_schedule=args.pp_schedule,
                                     pp_virtual=args.pp_virtual,
-                                    remat=REMAT_CHOICES[args.remat])
+                                    remat=REMAT_CHOICES[remat_key],
+                                    attn_t_real=attn_t_real)
         else:
             model = Transformer(cfg, tp_size=args.tp_size,
                             cp_size=args.cp_size, cp_impl=args.cp_impl,
@@ -362,7 +424,8 @@ def train(args: argparse.Namespace) -> dict:
                             pp_remat_steps=args.pp_remat_steps,
                             pp_schedule=args.pp_schedule,
                             pp_virtual=args.pp_virtual,
-                            remat=REMAT_CHOICES[args.remat])
+                            remat=REMAT_CHOICES[remat_key],
+                            attn_t_real=attn_t_real)
         ocfg = OptimizerConfig(lr=args.lr, warmup_steps=args.warmup_steps,
                                max_steps=args.max_steps,
                                clip_grad_norm=args.clip_grad_norm,
@@ -704,10 +767,14 @@ def train(args: argparse.Namespace) -> dict:
                             else accum
                     else:
                         steps_in = 1
+                    # bucket-pad the dispatched buffers only; `window`
+                    # keeps the real shape for the token accounting below
+                    w_feed = (_bucket_window(window, t_bucket) if t_bucket
+                              else window)
                     with observer.span("h2d"):
-                        ids = feed(window["input_ids"])
-                        tgt = feed(window["target_ids"])
-                        pos = feed(window["position_ids"])
+                        ids = feed(w_feed["input_ids"])
+                        tgt = feed(w_feed["target_ids"])
+                        pos = feed(w_feed["position_ids"])
                     params, opt_state, out = run_step(params, opt_state, ids,
                                                       tgt, pos, steps_in, n)
                     if multi:

@@ -1,0 +1,110 @@
+"""Activation-memory estimates + the per-config remat policy selector.
+
+The remat ladder ('false' fastest, 'dots' bounded residuals, 'true' lowest
+memory — models/transformer.py) has so far been picked by hand per preset.
+`select_remat` picks it from an itemised activation-memory estimate against
+the chip's HBM budget, so `--remat auto` (train.py / bench.py) runs the
+fastest policy that fits and steps down only when the numbers say so. The
+estimate is deliberately conservative (a `margin` headroom for XLA temps
+and fusion scratch); bench.py's OOM fallback ladder remains the safety net
+behind it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+# itemised per-layer residual footprint, in units of (b * t * dtype_bytes):
+#   'false' — everything autodiff saves on the flash path: layer input,
+#             2 norm outputs, q/k/v (k/v at the kv width), rope'd q/k,
+#             flash o + attn-proj input, wo output, gate/up/silu*up, down
+#             output  ->  ~9d + 4kd + 3f per token
+#   'dots'  — matmul outputs + the pinned flash o/lse only: q/k/v, o,
+#             wo out, gate/up, down out  ->  ~4d + 2kd + 2f
+#   'true'  — the layer-boundary carry only  ->  d
+_LAYER_UNITS = {
+    "false": lambda d, kd, f: 9 * d + 4 * kd + 3 * f,
+    "dots": lambda d, kd, f: 4 * d + 2 * kd + 2 * f,
+    "true": lambda d, kd, f: d,
+}
+
+
+def estimate_step_gib(cfg, batch: int, seqlen: int, remat: str,
+                      tp: int = 1, world: int = 1,
+                      dtype_bytes: int = 2) -> float:
+    """Peak-HBM estimate (GiB, per device) for one fwd+bwd+adam train step.
+
+    Fixed state: params + grads (f32) + 2 Adam moments (f32) = 16 bytes per
+    parameter, replicated over tp for the norm/embed parts but sharded for
+    the big matrices — approximated as P * 16 / max(tp, 1) + 10% for the
+    replicated remainder. Activations shard over tp (the t or head dim);
+    the batch shards over dp/ep, folded into `world / tp`.
+    """
+    remat = str(remat).lower()
+    if remat not in _LAYER_UNITS:
+        raise ValueError(f"remat must be one of {sorted(_LAYER_UNITS)}, "
+                         f"got {remat!r}")
+    d, f, L = cfg.attn_dim, cfg.ffn_dim, cfg.num_layers
+    kd = cfg.kv_dim
+    if cfg.num_experts:
+        # each token's residuals touch top_k expert FFNs plus the dispatch
+        # buffers (~capacity_factor x the dense width)
+        f = int(f * max(cfg.moe_top_k, 1) * cfg.moe_capacity_factor / 2)
+    P = cfg.num_params()
+    dp_like = max(world // max(tp, 1), 1)
+    b_local = max(batch // dp_like, 1)
+    tok = b_local * seqlen
+
+    fixed = P * 16 / max(tp, 1) * 1.10
+    acts = L * tok * _LAYER_UNITS[remat](d, kd, f) * dtype_bytes / max(tp, 1)
+    # flash lse rows (f32) are saved on every policy that keeps o/lse
+    if remat != "true":
+        acts += L * b_local * cfg.num_heads * seqlen * 4 / max(tp, 1)
+    # the head: logits in f32 for the CE (vocab-parallel: sharded over tp)
+    # appear twice at the bwd peak (value + cotangent)
+    logits = 2 * tok * cfg.padded_vocab_size(tp) * 4 / max(tp, 1)
+    # transient optimizer update working set ~ one f32 param tree
+    opt_scratch = P * 4 / max(tp, 1)
+    return (fixed + acts + logits + opt_scratch) / 1024 ** 3
+
+
+def hbm_budget_gib(default: float = 16.0) -> float:
+    """Per-device HBM, from the live backend when one is attached (CPU test
+    meshes report none and fall back to `default`, the v5e figure)."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = getattr(dev, "memory_stats", lambda: None)() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return limit / 1024 ** 3
+    except Exception:  # noqa: BLE001 — sizing must never kill the caller
+        pass
+    return default
+
+
+def select_remat(cfg, batch: int, seqlen: int, tp: int = 1, world: int = 1,
+                 budget_gib: Optional[float] = None,
+                 margin: float = 0.75, verbose: bool = True) -> str:
+    """The fastest remat policy whose estimated peak fits margin * budget.
+
+    Returns a REMAT_CHOICES key ('false' | 'dots' | 'true'). margin=0.75
+    leaves a quarter of HBM for XLA temps, fusion scratch, and the
+    donation-transition double-buffering the estimate cannot see.
+    """
+    budget = budget_gib if budget_gib is not None else hbm_budget_gib()
+    usable = budget * margin
+    picked = "true"
+    sizes = {}
+    for policy in ("false", "dots", "true"):
+        sizes[policy] = estimate_step_gib(cfg, batch, seqlen, policy,
+                                          tp=tp, world=world)
+        if sizes[policy] <= usable:
+            picked = policy
+            break
+    if verbose:
+        import sys
+        est = ", ".join(f"{p}={v:.2f}GiB" for p, v in sizes.items())
+        print(f"remat auto: picked '{picked}' (estimates {est}; budget "
+              f"{budget:.1f} GiB x margin {margin})", file=sys.stderr)
+    return picked

@@ -115,6 +115,12 @@ def parse_args(argv=None):
     ap.add_argument("--quick", action="store_true",
                     help="fewer block combos / iters")
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--write_cache", action="store_true",
+                    help="record each shape's winning combo in the "
+                         "autotuner cache (FLASH_BLOCKS_CACHE or "
+                         "~/.cache/dpfs_tpu/flash_blocks.json) so every "
+                         "later flash_attention call on this backend uses "
+                         "it automatically (get_block_config)")
     return ap.parse_args(argv)
 
 
@@ -132,10 +138,25 @@ def main():
     sizes = [256, 512, 1024] if args.quick else [128, 256, 512, 1024, 2048]
     blocks = list(itertools.product(sizes, sizes))
 
-    sweep_shape("reference 45m", 32, 8, 8, 1000, 64, blocks, args.iters)
-    sweep_shape("gqa 4x", 32, 8, 2, 1000, 64, blocks, args.iters)
-    sweep_shape("long context 8k", 2, 8, 8, 8192, 64, blocks,
-                max(5, args.iters // 4))
+    # NOTE cache keys are (t_pow2, head_dim, dtype, backend) — the gqa and
+    # reference shapes share one. The flagship (reference 45m) sweeps LAST
+    # so its entry is the one that persists.
+    shapes = [("gqa 4x", 32, 8, 2, 1000, 64, args.iters),
+              ("long context 8k", 2, 8, 8, 8192, 64,
+               max(5, args.iters // 4)),
+              ("reference 45m", 32, 8, 8, 1000, 64, args.iters)]
+    for name, b, h, hkv, t, d, iters in shapes:
+        best_fwd, best_bwd = sweep_shape(name, b, h, hkv, t, d, blocks,
+                                         iters)
+        if args.write_cache and best_fwd:
+            from distributed_pytorch_from_scratch_tpu.ops.pallas.flash_attention import (  # noqa: E501
+                BlockConfig, save_block_cache, set_block_config)
+            bb = best_bwd or (None, best_fwd[1], best_fwd[2])
+            set_block_config(t, d, jnp.bfloat16,
+                             BlockConfig(best_fwd[1], best_fwd[2],
+                                         bb[1], bb[2]))
+            path = save_block_cache()
+            print(f"  cached {name} -> {path}")
 
 
 if __name__ == "__main__":

@@ -104,6 +104,18 @@ def _core_match(name: str, pattern: str) -> bool:
 
 
 def pytest_collection_modifyitems(config, items):
+    # Every CORE_LANE file must exist ON DISK, unconditionally (ADVICE r5):
+    # the dead-pattern audit below only runs on full-suite collections, so
+    # a renamed/deleted file would otherwise drop its whole axis out of the
+    # core lane silently — the exact regression the lane guards against.
+    here = os.path.dirname(os.path.abspath(__file__))
+    missing = [f for f in CORE_LANE
+               if not os.path.exists(os.path.join(here, f))]
+    assert not missing, (
+        f"CORE_LANE lists test files that no longer exist on disk: "
+        f"{missing} — update CORE_LANE in tests/conftest.py to match the "
+        f"rename/deletion")
+
     core = pytest.mark.core
     matched = {}  # (file, pattern) -> hit count
     collected_files = set()

@@ -86,7 +86,7 @@ def test_breakdown_bench_emits_one_json_line():
     assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
     rec = json.loads(lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "components"}
+                        "components", "attribution"}
     assert rec["unit"] == "ms/step"
     comp = rec["components"]
     for key in ("h2d_ms", "fwd_ms", "fwdbwd_ms", "step_ms", "step_ms_spd4",
@@ -98,6 +98,44 @@ def test_breakdown_bench_emits_one_json_line():
                - (comp["fwdbwd_ms"] - comp["fwd_ms"])) < 0.02
     assert abs(comp["derived_dispatch_ms"]
                - (comp["step_ms"] - comp["step_ms_spd4"])) < 0.02
+    # the roofline attribution rides the same artifact: ranked suspects
+    # with shares of the measured amortised step
+    att = rec["attribution"]
+    assert att["analytic_step_ms"] > 0
+    ranks = [s["rank"] for s in att["suspects"]]
+    assert ranks == sorted(ranks) and ranks[0] == 1
+    est = [s["est_ms"] for s in att["suspects"]]
+    assert est == sorted(est, reverse=True)
+    # the measured dispatch gap must appear as a suspect (spd mode ran)
+    assert any(s["name"] == "dispatch overhead" for s in att["suspects"])
+
+
+def test_breakdown_analytic_emits_one_json_line():
+    """--breakdown --analytic: the CPU-runnable roofline attribution at the
+    FLAGSHIP 45m b32xt1000 shape (no device timing — milliseconds to run),
+    the exact artifact VERDICT r5 #1 asked for."""
+    p = subprocess.run(
+        [sys.executable, "-c", (
+            "import os;"
+            "os.environ['XLA_FLAGS']=os.environ.get('XLA_FLAGS','')"
+            " + ' --xla_force_host_platform_device_count=8';"
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import bench;"
+            "bench.main(['--model','45m','--breakdown','--analytic',"
+            "'--remat','dots','--tp','1'])")],
+        capture_output=True, text=True, timeout=500, cwd=REPO_ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "suspects"}
+    assert rec["unit"] == "ms/step (analytic)"
+    assert rec["value"] > 0
+    names = [s["name"] for s in rec["suspects"]]
+    assert any("tile/pad waste" in n for n in names), names
+    # the full human table lands on stderr for the session log
+    assert "step-time attribution" in p.stderr
+    assert "rank" in p.stderr
 
 
 def test_decode_bench_emits_one_json_line():

@@ -214,6 +214,203 @@ def test_block_attention_matches_xla_block():
         np.testing.assert_allclose(b_, a, atol=5e-5, err_msg=f"d{name}")
 
 
+# ---- pad-aware t_real path (sequence bucketing) ----
+
+
+def test_t_real_matches_sliced_oracle():
+    """t_real < t: rows below t_real match the oracle on the SLICED inputs
+    exactly; rows at/after t_real are hard zeros (the bucketing contract —
+    flash_attention docstring)."""
+    b, h, t, d, tr = 1, 2, 320, 32, 300
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, h, t, d))
+    v = jax.random.normal(kv, (b, h, t, d))
+    ref = causal_attention_xla(q[:, :, :tr], k[:, :, :tr], v[:, :, :tr])
+    for blocks in ({}, dict(block_q=128, block_k=128,
+                            bwd_block_q=128, bwd_block_k=128)):
+        out = flash_attention(q, k, v, t_real=tr, **blocks)
+        assert jnp.abs(out[:, :, :tr] - ref).max() < 1e-5
+        assert jnp.abs(out[:, :, tr:]).max() == 0.0
+
+
+def test_t_real_grads_exact_even_with_tail_cotangent():
+    """Gradients through the t_real path equal the sliced oracle's, and a
+    NONZERO cotangent on the pad rows contributes exactly zero (the pad
+    outputs are constants) — the invariant that keeps bucketing exact
+    under losses that touch every row (e.g. MoE aux sums)."""
+    b, h, t, d, tr = 1, 2, 320, 32, 300
+    keys = jax.random.split(jax.random.key(1), 4)
+    q, k, v, g = (jax.random.normal(kk, (b, h, t, d)) for kk in keys)
+
+    gr = jax.grad(
+        lambda *a: jnp.vdot(causal_attention_xla(*a), g[:, :, :tr]),
+        (0, 1, 2))(q[:, :, :tr], k[:, :, :tr], v[:, :, :tr])
+    # g carries nonzero values on rows >= tr on purpose
+    gf = jax.grad(
+        lambda *a: jnp.vdot(flash_attention(*a, t_real=tr), g),
+        (0, 1, 2))(q, k, v)
+    for a, b_ in zip(gr, gf):
+        assert jnp.abs(a - b_[:, :, :tr]).max() < 1e-4
+        assert jnp.abs(b_[:, :, tr:]).max() == 0.0
+
+
+def test_t_real_validation():
+    q = jnp.zeros((1, 2, 128, 16))
+    with pytest.raises(ValueError, match="t_real"):
+        flash_attention(q, q, q, t_real=0)
+    with pytest.raises(ValueError, match="t_real"):
+        flash_attention(q, q, q, t_real=129)
+
+
+@pytest.mark.slow
+def test_t_real_parity_reference_shape():
+    """The acceptance case: t=1000 real tokens in a t=1024 bucket equals
+    the plain t=1000 path and the vanilla oracle, at the reference head
+    shape (fwd; CPU interpreter)."""
+    b, h, t_pad, d, tr = 1, 8, 1024, 64, 1000
+    kq, kk, kv = jax.random.split(jax.random.key(2), 3)
+    q = jax.random.normal(kq, (b, h, t_pad, d))
+    k = jax.random.normal(kk, (b, h, t_pad, d))
+    v = jax.random.normal(kv, (b, h, t_pad, d))
+    ref = causal_attention_xla(q[:, :, :tr], k[:, :, :tr], v[:, :, :tr])
+    plain = flash_attention(q[:, :, :tr], k[:, :, :tr], v[:, :, :tr])
+    bucketed = flash_attention(q, k, v, t_real=tr,
+                               block_q=256, block_k=256)
+    assert jnp.abs(plain - ref).max() < 1e-5
+    assert jnp.abs(bucketed[:, :, :tr] - ref).max() < 1e-5
+    assert jnp.abs(bucketed[:, :, tr:]).max() == 0.0
+
+
+# ---- block-shape autotuner table + cache ----
+
+
+@pytest.fixture
+def block_table():
+    """Snapshot/restore the module-global tuned-block table around a test."""
+    from distributed_pytorch_from_scratch_tpu.ops.pallas import (
+        flash_attention as fa)
+
+    saved, saved_loaded = dict(fa._BLOCK_TABLE), fa._cache_loaded
+    fa._cache_loaded = True  # keep tests off the real user cache file
+    yield fa
+    fa._BLOCK_TABLE.clear()
+    fa._BLOCK_TABLE.update(saved)
+    fa._cache_loaded = saved_loaded
+
+
+def test_block_config_defaults_and_override(block_table):
+    fa = block_table
+    cfg = fa.get_block_config(333, 64, jnp.float32)
+    assert cfg == fa.BlockConfig()  # no entry -> the swept defaults
+    fa.set_block_config(333, 64, jnp.float32, fa.BlockConfig(128, 256,
+                                                             128, 128))
+    # t buckets by the padded pow2: 333 and 500 share the 512 entry
+    assert fa.get_block_config(500, 64, jnp.float32).block_k == 256
+    assert fa.get_block_config(600, 64, jnp.float32) == fa.BlockConfig()
+
+
+def test_block_cache_roundtrip(block_table, tmp_path):
+    fa = block_table
+    path = str(tmp_path / "blocks.json")
+    fa.set_block_config(256, 32, jnp.bfloat16, fa.BlockConfig(256, 128,
+                                                              128, 128))
+    fa.save_block_cache(path)
+    fa._BLOCK_TABLE.clear()
+    assert fa.get_block_config(256, 32, jnp.bfloat16) == fa.BlockConfig()
+    assert fa.load_block_cache(path) >= 1
+    assert fa.get_block_config(256, 32, jnp.bfloat16).block_q == 256
+    # a garbled cache is ignored, not fatal
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert fa.load_block_cache(str(bad)) == 0
+
+
+def test_tuned_blocks_drive_the_kernel(block_table):
+    """flash_attention with no explicit blocks must consult the table —
+    and stay correct with a deliberately odd tuned entry."""
+    fa = block_table
+    b, h, t, d = 1, 2, 300, 32
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    q = jax.random.normal(kq, (b, h, t, d))
+    k = jax.random.normal(kk, (b, h, t, d))
+    v = jax.random.normal(kv, (b, h, t, d))
+    fa.set_block_config(t, d, q.dtype, fa.BlockConfig(128, 256, 128, 128))
+    out = flash_attention(q, k, v)  # blocks=None -> table entry
+    ref = causal_attention_xla(q, k, v)
+    assert jnp.abs(out - ref).max() < 1e-5
+
+
+def test_autotune_caches_winner(block_table, tmp_path, monkeypatch):
+    """autotune_block_config sweeps, records the winner in the table, and
+    persists it through the JSON cache when asked."""
+    fa = block_table
+    monkeypatch.setenv("FLASH_BLOCKS_CACHE", str(tmp_path / "fb.json"))
+    best = fa.autotune_block_config(128, 16, jnp.float32, batch_heads=2,
+                                    sweep=(128,), iters=1, warmup=0,
+                                    write_cache=True)
+    assert best == fa.BlockConfig(128, 128, 128, 128)
+    assert fa.get_block_config(128, 16, jnp.float32) == best
+    fa._BLOCK_TABLE.clear()
+    assert fa.load_block_cache() >= 1  # reads FLASH_BLOCKS_CACHE
+    assert fa.get_block_config(128, 16, jnp.float32) == best
+
+
+# ---- model-level sequence bucketing (attn_t_real) ----
+
+
+@pytest.mark.parametrize("attn_impl", ["xla", "flash"])
+def test_model_seq_bucket_matches_unbucketed(attn_impl):
+    """A bucket-padded batch (t=200 real in a t=256 buffer, IGNORE_INDEX
+    pad targets) through a model with attn_t_real must reproduce the plain
+    model's loss AND grads exactly — the pad-aware bucketing acceptance
+    bar at model level."""
+    from distributed_pytorch_from_scratch_tpu.config import IGNORE_INDEX
+
+    cfg = ModelConfig(attn_dim=64, ffn_dim=128, num_heads=4, num_layers=2,
+                      vocab_size=128, maxlen=200, compute_dtype="float32")
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    tr, tp_ = 200, 256
+    m_plain = Transformer(cfg, tp_size=2, attn_impl=attn_impl, remat=False)
+    m_buck = Transformer(cfg, tp_size=2, attn_impl=attn_impl, remat=False,
+                         attn_t_real=tr)
+    params = jax.device_put(m_plain.init(jax.random.key(0)),
+                            m_plain.shardings(mesh))
+    b = 4
+    ids = jax.random.randint(jax.random.key(3), (b, tr), 0, cfg.vocab_size)
+    tgt = jnp.roll(ids, -1, axis=1)
+    pos = jnp.tile(jnp.arange(tr, dtype=jnp.int32)[None], (b, 1))
+    ids_p = jnp.pad(ids, ((0, 0), (0, tp_ - tr)))
+    tgt_p = jnp.pad(tgt, ((0, 0), (0, tp_ - tr)),
+                    constant_values=IGNORE_INDEX)
+    pos_p = jnp.pad(pos, ((0, 0), (0, tp_ - tr)), mode="edge")
+
+    l0 = m_plain.make_loss(mesh)(params, ids, tgt, pos)
+    l1 = m_buck.make_loss(mesh)(params, ids_p, tgt_p, pos_p)
+    np.testing.assert_allclose(float(l1), float(l0), atol=1e-6)
+    g0 = jax.grad(lambda p: m_plain.make_loss(mesh)(p, ids, tgt, pos))(
+        params)
+    g1 = jax.grad(lambda p: m_buck.make_loss(mesh)(p, ids_p, tgt_p,
+                                                   pos_p))(params)
+    jax.tree.map(lambda a, b_: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b_), atol=1e-5), g0, g1)
+
+
+def test_model_t_real_requires_cp1():
+    cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=2,
+                      vocab_size=64, maxlen=64)
+    with pytest.raises(ValueError, match="cp_size"):
+        Transformer(cfg, cp_size=2, attn_t_real=48)
+    with pytest.raises(ValueError, match="attn_t_real"):
+        Transformer(cfg, attn_t_real=0)
+    # MoE: the router sees every position — pad tokens would claim expert
+    # capacity and inflate the aux losses, so bucketing must refuse
+    import dataclasses
+    moe_cfg = dataclasses.replace(cfg, num_experts=4)
+    with pytest.raises(ValueError, match="MoE"):
+        Transformer(moe_cfg, attn_t_real=48)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("group", [1, 2, 4])
 @pytest.mark.parametrize("t", [96, 256])

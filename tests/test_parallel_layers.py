@@ -201,11 +201,11 @@ def test_embedding_does_not_mutate_input(mesh):
 
 # -------------------------------------------------- multi-step training -----
 
-def test_column_parallel_multi_step_training(mesh):
-    """Reference check #3 (`test_column_parallel_linear.py:111-135`): many
-    SGD steps on parallel vs vanilla; final weights AND the whole loss
-    history must match."""
-    idim, odim, steps, lr = 16, 32, EQUIV_STEPS, 1e-2
+def _column_parallel_history(mesh, steps):
+    """Shared body of the column-parallel multi-step check — the default
+    lane runs it at EQUIV_STEPS, the slow lane at the reference's full
+    1000 steps (see below)."""
+    idim, odim, lr = 16, 32, 1e-2
     layer = ColumnParallelLinear(idim, odim, gather_output=False)
     key = jax.random.key(11)
     params_sh = layer.init(key)
@@ -241,6 +241,24 @@ def test_column_parallel_multi_step_training(mesh):
     np.testing.assert_allclose(hist_sh, hist_ref, atol=1e-5)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5),
                  params_sh, params_ref)
+
+
+def test_column_parallel_multi_step_training(mesh):
+    """Reference check #3 (`test_column_parallel_linear.py:111-135`): many
+    SGD steps on parallel vs vanilla; final weights AND the whole loss
+    history must match."""
+    _column_parallel_history(mesh, EQUIV_STEPS)
+
+
+@pytest.mark.slow
+def test_column_parallel_multi_step_training_full_reference_bar(mesh):
+    """VERDICT r5 #6: the reference asserts its equivalence over 1000
+    optimizer steps (`test_column_parallel_linear.py:111-135`). The
+    default lane runs EQUIV_STEPS (200) for speed; this slow-lane pin
+    runs the FULL 1000 unconditionally, so the reference's bar stays
+    continuously green in CI instead of only via the EQUIV_STEPS env
+    override once per round."""
+    _column_parallel_history(mesh, 1000)
 
 
 def test_row_parallel_multi_step_training(mesh):

@@ -22,21 +22,25 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 R5 = os.path.join(REPO, "runs", "r5")
 
-SESSION_SCRIPTS = [os.path.join(R5, n) for n in sorted(os.listdir(R5))
-                   if n.endswith(".sh")]
+# every staged session dir gets preflighted (r6 stages the fast-45m pass)
+SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"))
+                if os.path.isdir(d)]
+SESSION_SCRIPTS = [os.path.join(d, n)
+                   for d in SESSION_DIRS
+                   for n in sorted(os.listdir(d)) if n.endswith(".sh")]
 
-# shell variables the session scripts define; substituted before lexing
+# shell variables the session scripts define; substituted before lexing.
+# $R/$M are per-script (the sourcing script's runs dir).
 SHELL_VARS = {
-    "R": "runs/r5",
-    "M": "runs/r5/session_manifest.jsonl",
     "TOKENS": "/tmp/corpus_tokens.json",
     "LOG": "/tmp/tpu_status_r5.txt",
 }
 REDIRECT = re.compile(r"^\d*(>>?|\|)|^\|\|?$|^&&$|^2>>?$")
 
 
-def _sub_vars(line: str) -> str:
-    for k, v in SHELL_VARS.items():
+def _sub_vars(line: str, rdir: str) -> str:
+    subs = dict(SHELL_VARS, R=rdir, M=f"{rdir}/session_manifest.jsonl")
+    for k, v in subs.items():
         line = line.replace("${%s}" % k, v).replace("$%s" % k, v)
     return line
 
@@ -56,11 +60,12 @@ def _strip_shell_tail(tokens):
 def extract_commands(path):
     """Yield (lineno, argv) for every staged python command in a script."""
     text = open(path).read()
+    rdir = "runs/" + os.path.basename(os.path.dirname(path))
     # join backslash continuations
     text = re.sub(r"\\\n\s*", " ", text)
     cmds = []
     for lineno, raw in enumerate(text.splitlines(), 1):
-        line = _sub_vars(raw.strip())
+        line = _sub_vars(raw.strip(), rdir)
         if not line or line.startswith("#"):
             continue
         # bench_line TAG TIMEOUT flags...  =>  python bench.py flags...

@@ -1,0 +1,51 @@
+"""VERDICT r5 #5: a ulysses-trained config meeting `--cp_size > 1` at
+generation time must refuse LOUDLY with a pointer to the ring path (the KV
+decoder's cp prefill is ring-only, models/decode.py::_prefill_cp) instead
+of silently requiring it. Both CLIs validate before touching any file, so
+these run with dummy paths."""
+
+import pytest
+
+from distributed_pytorch_from_scratch_tpu import evaluate as eval_mod
+from distributed_pytorch_from_scratch_tpu import generate as gen_mod
+
+
+def test_generate_refuses_ulysses_cp():
+    args = gen_mod.get_generate_args(
+        ["--ckpt_dir", "/nonexistent", "--tokenizer_path", "/nonexistent",
+         "--prompt", "hi", "--cp_size", "2", "--cp_impl", "ulysses"])
+    with pytest.raises(SystemExit, match="ring-only"):
+        gen_mod.generate(args)
+
+
+def test_generate_ring_passes_the_gate():
+    """The same flags with --cp_impl ring must get PAST the refusal (and
+    fail later on the dummy tokenizer path instead)."""
+    args = gen_mod.get_generate_args(
+        ["--ckpt_dir", "/nonexistent", "--tokenizer_path", "/nonexistent",
+         "--prompt", "hi", "--cp_size", "2"])
+    with pytest.raises(Exception) as e:
+        gen_mod.generate(args)
+    assert "ring-only" not in str(e.value)
+
+
+def test_evaluate_refuses_ulysses_cp_decode():
+    args = eval_mod.get_eval_args(
+        ["--data_path", "/nonexistent", "--tokenizer_path", "/nonexistent",
+         "--ckpt_dir", "/nonexistent", "--cp_size", "2",
+         "--cp_impl", "ulysses"])
+    with pytest.raises(SystemExit, match="ring-only"):
+        eval_mod.evaluate(args)
+
+
+def test_evaluate_ulysses_allowed_without_kv_decode():
+    """--no_kv_cache decodes on the cp=1 dense path, so ulysses val loss
+    is fine there: the gate must NOT fire (the dummy data path fails
+    later instead)."""
+    args = eval_mod.get_eval_args(
+        ["--data_path", "/nonexistent", "--tokenizer_path", "/nonexistent",
+         "--ckpt_dir", "/nonexistent", "--cp_size", "2",
+         "--cp_impl", "ulysses", "--no_kv_cache"])
+    with pytest.raises(Exception) as e:
+        eval_mod.evaluate(args)
+    assert "ring-only" not in str(e.value)
