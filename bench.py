@@ -80,6 +80,23 @@ def parse_args(argv=None):
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--tp", type=int, default=0,
                    help="0 = all remaining local chips")
+    p.add_argument("--sequence_parallel", action="store_true",
+                   help="Megatron SP over tp (reduce-scatter/all-gather "
+                        "instead of all-reduce); needed for --tp_overlap")
+    p.add_argument("--tp_overlap", default="off", choices=["off", "ring"],
+                   help="'ring' = ring-decomposed collective matmuls for "
+                        "the SP tp collectives (ops/overlap.py); the "
+                        "breakdown/attribution then reports the comm the "
+                        "ring hides. Requires --sequence_parallel")
+    p.add_argument("--dp_reduce_bucket_mb", type=float, default=0.0,
+                   help="bucketed DP grad reduction: one psum per <= N-MiB "
+                        "bucket (overlappable with the backward) instead "
+                        "of the end-of-step whole-tree blob; 0 = off")
+    p.add_argument("--dp_reduce_dtype", default="f32",
+                   choices=["f32", "bf16"],
+                   help="wire dtype for the bucketed DP reduce (bf16 "
+                        "halves the reduction bytes; f32 master "
+                        "accumulate untouched)")
     p.add_argument("--iters", type=int, default=8)
     # The product training mode this measures: train.py --steps_per_dispatch
     # runs N optimizer steps per device dispatch (lax.scan over a stacked
@@ -131,6 +148,17 @@ def parse_args(argv=None):
         args.remat = "dots" if args.model == "gpt2-355m" else "false"
     if args.analytic and not args.breakdown:
         p.error("--analytic is a --breakdown mode")
+    if args.analytic and args.remat == "auto":
+        p.error("--analytic needs an explicit --remat (auto resolves "
+                "against the attached chip's memory; --analytic runs "
+                "without a backend)")
+    if args.tp_overlap == "ring" and not args.sequence_parallel:
+        p.error("--tp_overlap ring requires --sequence_parallel")
+    if args.dp_reduce_dtype == "bf16" and not args.dp_reduce_bucket_mb:
+        p.error("--dp_reduce_dtype bf16 needs --dp_reduce_bucket_mb > 0")
+    if args.dp_reduce_bucket_mb and args.model.endswith("-moe8"):
+        p.error("--dp_reduce_bucket_mb does not compose with MoE presets "
+                "(expert grads are ep-sharded, not batch-replicated)")
     if args.seq_bucket and (args.seq_bucket < 1 or args.seq_bucket % 128):
         p.error(f"--seq_bucket must be a positive multiple of 128 (the TPU "
                 f"lane width), got {args.seq_bucket}")
@@ -141,7 +169,9 @@ def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto",
                 attn_t_real: int = None):
     """The one family dispatch shared by the training/decode/breakdown
     paths (three copies had already diverged once)."""
-    kw = dict(tp_size=tp, attn_impl=attn_impl, attn_t_real=attn_t_real)
+    kw = dict(tp_size=tp, attn_impl=attn_impl, attn_t_real=attn_t_real,
+              sequence_parallel=args.sequence_parallel,
+              tp_overlap=args.tp_overlap)
     if remat is not None:
         kw["remat"] = REMAT_CHOICES[remat]
     if args.family == "gpt2":
@@ -149,6 +179,13 @@ def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto",
             GPT2Transformer)
         return GPT2Transformer(cfg, **kw)
     return Transformer(cfg, **kw)
+
+
+def dp_reduce_kwargs(args):
+    """Step-builder kwargs for the bucketed DP grad reduce flags."""
+    return dict(dp_reduce_bucket_mb=args.dp_reduce_bucket_mb,
+                dp_reduce_dtype=(jnp.bfloat16
+                                 if args.dp_reduce_dtype == "bf16" else None))
 
 
 def bucket_shape(args, cfg):
@@ -308,27 +345,39 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
     T, T_pad = bucket_shape(args, cfg)
     world = args.dp * tp
 
-    def emit(measured=None, comp=None):
+    def emit(measured=None, comp=None, allreduce_us=None):
         report = attribution(
             cfg, B, T_pad, remat=args.remat, spd=spd,
             t_real=T if T_pad > T else None,
             measured=measured, chip=chip_key(), world=world,
-            family=args.family)
+            family=args.family, tp=tp, sp=args.sequence_parallel,
+            tp_overlap=args.tp_overlap, dp=args.dp,
+            dp_bucket_mb=args.dp_reduce_bucket_mb,
+            dp_reduce_dtype=args.dp_reduce_dtype,
+            measured_allreduce_us=allreduce_us)
         print(format_attribution(report, measured), file=sys.stderr)
         return report
 
     if args.analytic:
         report = emit()
         shape = f"b{B}xt{T}" + (f"->t{T_pad}" if T_pad > T else "")
+        comm = report["comm"]
         print(json.dumps({
             "metric": (f"step-time attribution ({args.model} {args.family}, "
-                       f"{shape}, remat={args.remat}, "
+                       f"{shape}, remat={args.remat}, tp={tp}, "
+                       f"sp={args.sequence_parallel}, "
+                       f"tp_overlap={args.tp_overlap}, "
                        f"ANALYTIC {report['chip']} roofline — no device "
                        f"timing; value = analytic step ms, vs_baseline = "
                        f"top suspect's share of the step"),
             "value": round(report["analytic_step_ms"], 2),
             "unit": "ms/step (analytic)",
             "vs_baseline": round(report["suspects"][0]["share"], 4),
+            "comm": {
+                "total_ms": round(comm["comm_total_ms"], 3),
+                "hidden_ms": round(comm["comm_hidden_ms"], 3),
+                "exposed_ms": round(comm["comm_exposed_ms"], 3),
+            },
             "suspects": [{k: (round(v, 3) if isinstance(v, float) else v)
                           for k, v in s.items()}
                          for s in report["suspects"]],
@@ -389,13 +438,34 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
                 grad_fn.lower(params, ids, tgt, pos).compile())
             introspection = format_analysis(
                 analysis, model_flops=flops / (args.dp * tp))
+            if args.tp_overlap == "ring" and tp > 1:
+                # cross-check the HLO's collective-permute bytes against
+                # the ring's chunk schedule: the scanned layer body holds
+                # ONE layer's ring ops in the program text, so the
+                # comparable number is the per-layer fwd+bwd chunk bytes
+                # (+ the unscanned head rings)
+                from distributed_pytorch_from_scratch_tpu.obs.attribution \
+                    import ring_chunk_bytes
+                sched = ring_chunk_bytes(cfg, B, T_pad, tp)
+                expect = (sched["per_layer_fwd_bytes"]
+                          + sched["per_layer_bwd_bytes"]
+                          + sched["head_fwd_bytes"]
+                          + sched["head_bwd_bytes"])
+                hlo_cp = analysis.get("collectives", {}).get(
+                    "collective-permute", {"count": 0, "bytes": 0})
+                introspection += (
+                    f"; ring chunk schedule expects "
+                    f"{expect / 2**20:.1f} MiB of collective-permute in "
+                    f"the program text (per-layer body + head), HLO has "
+                    f"x{hlo_cp['count']} ({hlo_cp['bytes'] / 2**20:.1f} "
+                    f"MiB)")
         except Exception as e:  # noqa: BLE001 — diagnostics must not kill
             introspection = (f"unavailable: {type(e).__name__}: "
                              f"{str(e)[:200]}")
 
     # full step programs donate params/opt_state: thread them through
     opt_state = init_adam_state(params)
-    step_fn = build_train_step(model, mesh, ocfg)
+    step_fn = build_train_step(model, mesh, ocfg, **dp_reduce_kwargs(args))
     state = [params, opt_state]
 
     def one_step():
@@ -406,7 +476,8 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
 
     ids_n, tgt_n, pos_n = (jnp.tile(x[None], (spd, 1, 1))
                            for x in (ids, tgt, pos))
-    multi_fn = build_train_step_multi(model, mesh, ocfg)
+    multi_fn = build_train_step_multi(model, mesh, ocfg,
+                                      **dp_reduce_kwargs(args))
     # fresh state: the donated buffers above were consumed
     params2 = jax.device_put(model.init(jax.random.key(0)),
                              model.shardings(mesh))
@@ -440,7 +511,12 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         print(f"breakdown introspection (fwd+bwd program): {introspection}",
               file=sys.stderr)
 
-    report = emit(measured=comp)
+    # the 4 MiB tp all-reduce p50 calibrates the comm attribution's ICI
+    # bandwidth term (obs/attribution.calibrate_ici) — measured on THIS
+    # chip session, so the hidden/exposed split tracks the attached
+    # hardware rather than the datasheet
+    p50_us = allreduce_p50_us(mesh, "tp") if tp > 1 else None
+    report = emit(measured=comp, allreduce_us=p50_us)
     print(json.dumps({
         "metric": (f"step-time breakdown ({args.model}, bf16, {shape_note}, "
                    f"remat={args.remat}; value = single-dispatch step ms, "
@@ -453,6 +529,11 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         "attribution": {
             "analytic_step_ms": round(report["analytic_step_ms"], 2),
             "chip": report["chip"],
+            "comm": {
+                "total_ms": round(report["comm"]["comm_total_ms"], 3),
+                "hidden_ms": round(report["comm"]["comm_hidden_ms"], 3),
+                "exposed_ms": round(report["comm"]["comm_exposed_ms"], 3),
+            },
             "suspects": [{k: (round(v, 3) if isinstance(v, float) else v)
                           for k, v in s.items()}
                          for s in report["suspects"]],
@@ -461,16 +542,23 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
 
 
 def _discover_backend(probe=None, timeout_s=240.0):
-    """Device count, or ONE machine-readable JSON error line + exit rc=3.
+    """Device count, or ONE machine-readable JSON error line + exit rc=0.
 
     Backend discovery is the only step that has ever voided a BENCH
     artifact (rounds 1-3 all failed here when the axon TPU tunnel was
     down: either `jax.device_count()` raised during plugin init, or it
     hung forever and the driver's timeout killed the process with a raw
-    traceback).  Both modes now yield a single parseable
-    `{"error": "backend_unavailable", ...}` line on stdout and a
-    distinct exit code, so the driver's BENCH_r*.json stays
-    machine-readable in the exact scenario that keeps occurring.
+    traceback).  Both modes yield a single parseable
+    `{"error": "backend_unavailable", ...}` line on stdout.
+
+    Exit code is 0 (BENCH_r05: the driver records rc!=0 as a failed bench
+    and DROPS the artifact, losing the trajectory point — rc=3 threw away
+    exactly the machine-readable record this path exists to preserve).
+    An outage is an ENVIRONMENT fact the record itself conveys; consumers
+    key on the `error` field (runs/r5/session_lib.sh's bench_line guard
+    already deletes `"error"` artifacts before re-running). Real
+    measurement failures — OOM ladders exhausted, bad flags, a crash
+    mid-timing — still exit nonzero through their own raise paths.
 
     The probe runs in a daemon thread because a hung PJRT client init
     cannot be interrupted from Python — on timeout we flush the JSON
@@ -494,11 +582,11 @@ def _discover_backend(probe=None, timeout_s=240.0):
                           "detail": f"backend init hung > {timeout_s:.0f}s"}))
         sys.stdout.flush()
         sys.stderr.flush()
-        os._exit(3)
+        os._exit(0)
     if "n" not in result:
         print(json.dumps({"metric": "bench", "error": "backend_unavailable",
                           "detail": result.get("err", "probe died")}))
-        raise SystemExit(3)
+        raise SystemExit(0)
     return result["n"]
 
 
@@ -510,13 +598,25 @@ def main(argv=None):
         timeout_s = 240.0
     n_dev = _discover_backend(timeout_s=timeout_s)
     tp = args.tp or max(1, n_dev // args.dp)
-    mesh = make_mesh(MeshConfig(dp=args.dp, tp=tp))
+    if args.dp_reduce_bucket_mb and tp > 1 and not args.sequence_parallel:
+        # fail HERE with the same clean message train.py gives — inside
+        # build() the ValueError would be retried through every
+        # fallback-ladder rung and misreported as a compile failure
+        raise SystemExit("--dp_reduce_bucket_mb with tp > 1 needs "
+                         "--sequence_parallel (the non-SP path all-reduces "
+                         "inside every row-parallel layer; see "
+                         "training/zero.build_bucketed_grad_fn)")
     cfg = model_preset(args.model, compute_dtype="bfloat16")
     if args.seq_bucket and cfg.num_experts:
         raise SystemExit("--seq_bucket does not compose with MoE presets: "
                          "the router sees every position, so pad tokens "
                          "would claim expert-capacity slots and inflate "
                          "the aux losses")
+    if args.breakdown and args.analytic:
+        # pure host math — no mesh, so `--tp 4 --analytic` prices a 4-chip
+        # overlapped config from a 1-chip (or CPU) box
+        return run_breakdown(args, None, cfg, tp)
+    mesh = make_mesh(MeshConfig(dp=args.dp, tp=tp))
     if args.remat == "auto":
         from distributed_pytorch_from_scratch_tpu.training.memory import (
             select_remat)
@@ -555,7 +655,8 @@ def main(argv=None):
                                 model.shardings(mesh))
         opt_state = init_adam_state(params)
         builder = build_train_step_multi if spd > 1 else build_train_step
-        return params, opt_state, builder(model, mesh, ocfg)
+        return params, opt_state, builder(model, mesh, ocfg,
+                                          **dp_reduce_kwargs(args))
 
     # Fallback ladder: the requested config first, then progressively safer
     # ones (full remat for memory, XLA attention for kernel-compile issues).
@@ -653,11 +754,17 @@ def main(argv=None):
 
     bucket_note = (f", seq_bucket t{T}->t{T_pad} (real tokens counted)"
                    if T_pad > T else "")
+    overlap_note = ""
+    if args.sequence_parallel:
+        overlap_note = f", sp, tp_overlap={args.tp_overlap}"
+    if args.dp_reduce_bucket_mb:
+        overlap_note += (f", dp_reduce_bucket={args.dp_reduce_bucket_mb:g}MiB"
+                         f" {args.dp_reduce_dtype}")
     print(json.dumps({
         "metric": (f"tokens/sec/chip ({args.model} {args.family}, bf16, b{B}xt{T}, "
                    f"dp={args.dp}, tp={tp}, remat={remat_used}, "
                    f"attn={attn_used}, steps_per_dispatch={spd}"
-                   f"{bucket_note})"),
+                   f"{bucket_note}{overlap_note})"),
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu / 0.30, 4),

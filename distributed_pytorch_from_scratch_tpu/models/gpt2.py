@@ -56,8 +56,10 @@ from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
 from ..parallel.moe import MoEFFN
 from ..parallel.norm import LayerNorm
 from ..runtime.prng import fold
-from .transformer import (NEG_INF, Transformer, remat_wrap,
-                          validate_cp, validate_pp, validate_t_real)
+from ..ops.overlap import ag_matmul
+from ..parallel.linear import apply_column_ring_fused
+from .transformer import (NEG_INF, Transformer, remat_wrap, validate_cp,
+                          validate_pp, validate_t_real, validate_tp_overlap)
 
 Params = Dict[str, Any]
 
@@ -80,6 +82,10 @@ class GPT2Transformer:
     cp_impl: str = "ring"
     cp_layout: str = "contiguous"
     sequence_parallel: bool = False
+    # 'ring' = ring-decomposed collective matmuls for the SP tp collectives
+    # — same contract as Transformer.tp_overlap (requires
+    # sequence_parallel; the tied head rings too)
+    tp_overlap: str = "off"
     pp_size: int = 1
     pp_microbatches: int = 0
     pp_remat_steps: bool = False
@@ -116,6 +122,8 @@ class GPT2Transformer:
                              "(a dense model has nothing to shard over 'ep'; "
                              "use dp for a pure data axis)")
         validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
+        validate_tp_overlap(self.tp_overlap, self.sequence_parallel,
+                            cfg.num_experts)
         validate_pp(cfg.num_layers, self.pp_size, self.pp_microbatches,
                     self.pp_schedule, self.pp_virtual)
         validate_t_real(self.attn_t_real, self.cp_size, cfg.num_experts)
@@ -161,12 +169,15 @@ class GPT2Transformer:
     @functools.cached_property
     def _mods(self) -> Dict[str, Any]:
         d, f = self.d, self.cfg.ffn_dim
+        ov = self.tp_overlap
         mods = {
             "ln1": LayerNorm(d),
+            # wq/wk/wv stay overlap='off': the fused ring in _layer_body
+            # covers them on ONE shared ring (shared-gather byte parity)
             "wq": ColumnParallelLinear(d, d, gather_output=False),
             "wk": ColumnParallelLinear(d, d, gather_output=False),
             "wv": ColumnParallelLinear(d, d, gather_output=False),
-            "wo": RowParallelLinear(d, d, split_input=False),
+            "wo": RowParallelLinear(d, d, split_input=False, overlap=ov),
             "ln2": LayerNorm(d),
         }
         if self.is_moe:
@@ -182,8 +193,10 @@ class GPT2Transformer:
                 ep_size=self.ep_size, tp_size=self.tp_size)
         else:
             mods.update({
-                "fc": ColumnParallelLinear(d, f, gather_output=False),
-                "proj": RowParallelLinear(f, d, split_input=False),
+                "fc": ColumnParallelLinear(d, f, gather_output=False,
+                                           overlap=ov),
+                "proj": RowParallelLinear(f, d, split_input=False,
+                                          overlap=ov),
             })
         return mods
 
@@ -250,18 +263,27 @@ class GPT2Transformer:
         # projections, row-linear outputs reduce-scatter back (the same
         # Megatron SP pattern as Transformer._layer_body)
         sp = self.sequence_parallel
+        # ring overlap: the sublayer gather never materialises — the fused
+        # ring collective matmul consumes the seq-sharded activation (same
+        # contract as Transformer._layer_body)
+        ring_ov = sp and self.tp_overlap == "ring"
         maybe_gather = ((lambda z: gather_from(z, "tp", tiled_axis=-2))
-                        if sp else (lambda z: z))
-        in_layout = "gathered" if sp else "replicated"
+                        if sp and not ring_ov else (lambda z: z))
+        in_layout = ("seq_sharded" if ring_ov
+                     else "gathered" if sp else "replicated")
         out_layout = "seq_sharded" if sp else "replicated"
         b = x.shape[0]
         t = pos.shape[1]  # full (cp-local) sequence length, not x.shape[1]
 
         def qkv(x):
             y = maybe_gather(m["ln1"].apply(lp["ln1"], x))
-            q = m["wq"].apply(lp["wq"], y, dtype, input_layout=in_layout)
-            k = m["wk"].apply(lp["wk"], y, dtype, input_layout=in_layout)
-            v = m["wv"].apply(lp["wv"], y, dtype, input_layout=in_layout)
+            if ring_ov:
+                q, k, v = apply_column_ring_fused(
+                    (lp["wq"], lp["wk"], lp["wv"]), y, dtype)
+            else:
+                q = m["wq"].apply(lp["wq"], y, dtype, input_layout=in_layout)
+                k = m["wk"].apply(lp["wk"], y, dtype, input_layout=in_layout)
+                v = m["wv"].apply(lp["wv"], y, dtype, input_layout=in_layout)
             split = lambda z: z.reshape(
                 b, t, self.num_local_heads, h).transpose(0, 2, 1, 3)
             return split(q), split(k), split(v)
@@ -294,12 +316,15 @@ class GPT2Transformer:
                                     output_layout=out_layout)
             return x, None
 
-        if live is None:
+        # ring overlap: dense segments run even on bubble steps (their tp
+        # ppermutes cannot hide in a stage-divergent cond — see
+        # Transformer._layer_body)
+        if live is None or ring_ov:
             q, k, v = qkv(x)
             if self.cp_size > 1:
                 if self.cp_impl == "ring":
                     o = ring_attention(q, k, v, pos, axis="cp",
-                                       impl=self.attn_impl)
+                                       impl=self.attn_impl, live=live)
                 else:
                     o = ulysses_attention(q, k, v, axis="cp",
                                           impl=self.attn_impl)
@@ -366,14 +391,19 @@ class GPT2Transformer:
             aux = (jax.tree.map(lambda a: jnp.sum(a, axis=0), auxs)
                    if self.is_moe else None)
         x = self.final_norm.apply(params["norm"], x)
-        if sp:
-            # the tied head consumes full-sequence activations; the gather's
-            # transpose reduce-scatters the head's input cotangent
-            x = gather_from(x, "tp", tiled_axis=-2)
-
         # tied head: local logits against this shard's embedding rows
         w = params["embedding"]["weight"].astype(dtype)  # (vp/tp, d)
-        logits = x.astype(dtype) @ w.T                    # (b, t, vp/tp)
+        if sp and self.tp_overlap == "ring":
+            # ring collective matmul for the tied head too: the gather's
+            # hops hide under the per-chunk logits dots, and the VJP's
+            # reverse ring reduce-scatters the head's input cotangent
+            logits = ag_matmul(x.astype(dtype), (w.T,), "tp")[0]
+        else:
+            if sp:
+                # the tied head consumes full-sequence activations; the
+                # gather's transpose reduce-scatters the input cotangent
+                x = gather_from(x, "tp", tiled_axis=-2)
+            logits = x.astype(dtype) @ w.T                # (b, t, vp/tp)
 
         if self.vocab_padded != self.cfg.vocab_size:
             local_v = self.vocab_padded // self.tp_size

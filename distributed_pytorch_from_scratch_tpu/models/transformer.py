@@ -46,7 +46,8 @@ from ..ops.collectives import copy_to, gather_from, reduce_from
 from ..ops.ring_attention import ring_attention, ulysses_attention
 from ..ops.rope import apply_rotary, rope_tables
 from ..parallel.embedding import VocabParallelEmbedding
-from ..parallel.linear import ColumnParallelLinear, RowParallelLinear
+from ..parallel.linear import (ColumnParallelLinear, RowParallelLinear,
+                               apply_column_ring_fused)
 from ..parallel.moe import MoEFFN, aux_losses, aux_zeros
 from ..parallel.norm import RMSNorm
 from ..runtime.prng import fold
@@ -139,6 +140,24 @@ def validate_t_real(attn_t_real, cp_size: int, num_experts: int = 0) -> None:
             "training would silently diverge from unbucketed")
 
 
+def validate_tp_overlap(tp_overlap: str, sequence_parallel: bool,
+                        num_experts: int = 0) -> None:
+    """tp_overlap construction checks shared by both model families."""
+    if tp_overlap not in ("off", "ring"):
+        raise ValueError(f"tp_overlap must be 'off' or 'ring', got "
+                         f"{tp_overlap!r}")
+    if tp_overlap == "ring" and not sequence_parallel:
+        raise ValueError(
+            "tp_overlap='ring' requires sequence_parallel: the ring "
+            "decomposes the SP all-gather/reduce-scatter pair; the non-SP "
+            "path's monolithic all-reduce has no chunk schedule to overlap")
+    if tp_overlap == "ring" and num_experts:
+        raise ValueError(
+            "tp_overlap='ring' does not compose with MoE yet: the router "
+            "consumes the full-token gather that the ring collective "
+            "matmul deliberately never materialises")
+
+
 def remat_wrap(layer_fn, remat, static_argnums=()):
     """Apply a per-layer remat policy; shared by every model family.
 
@@ -223,6 +242,19 @@ class Transformer:
     # memory drops by 1/tp. Composes with cp (t is sharded over cp first,
     # then tp).
     sequence_parallel: bool = False
+    # Communication overlap for the tp collectives (requires
+    # sequence_parallel): 'ring' swaps the monolithic per-sublayer
+    # all-gather/reduce-scatter for ring-decomposed collective matmuls
+    # (ops/overlap.py) — each ppermute hop hides under the partial dot of
+    # the chunk already in hand, fwd and bwd. 'off' (default) stays
+    # bit-identical to today's path. Composes with dp/cp/pp; under a pp
+    # mesh the ring's ppermutes must execute on EVERY pipeline step
+    # (collective-permute lowers with a global participant list), so the
+    # dense segments run ungated and bubble steps burn their FLOPs —
+    # garbage flows only into garbage (see _pipeline_layers) — trading
+    # bubble compute for hidden wire. Not yet composed with MoE (the
+    # router needs the full-token gather the ring never materialises).
+    tp_overlap: str = "off"
     # Rematerialise each decoder layer in the backward pass instead of saving
     # its activations (the naive O(T^2) attention otherwise stores
     # (L, b, heads, t, t) softmax residuals — 11.7 GiB for the reference's
@@ -268,6 +300,8 @@ class Transformer:
             raise ValueError(f"num_kv_heads {cfg.kv_heads} not divisible by "
                              f"tp_size {tp}")
         validate_cp(cfg, tp, self.cp_size, self.cp_impl, self.cp_layout)
+        validate_tp_overlap(self.tp_overlap, self.sequence_parallel,
+                            cfg.num_experts)
         if not cfg.num_experts and self.ep_size > 1:
             raise ValueError("ep_size > 1 requires cfg.num_experts > 0 "
                              "(a dense model has nothing to shard over 'ep'; "
@@ -314,11 +348,15 @@ class Transformer:
     def _mods(self) -> Dict[str, Any]:
         d, f = self.d, self.cfg.ffn_dim
         kd = self.cfg.kv_dim  # < d under grouped-query attention
+        ov = self.tp_overlap
         mods = {
+            # wq/wk/wv (and gate/up) stay overlap='off': under ring overlap
+            # the fused multi-weight ring in _layer_body covers them (one
+            # ring shared per sublayer = the shared-gather byte parity)
             "wq": ColumnParallelLinear(d, d, gather_output=False),
             "wk": ColumnParallelLinear(d, kd, gather_output=False),
             "wv": ColumnParallelLinear(d, kd, gather_output=False),
-            "wo": RowParallelLinear(d, d, split_input=False),
+            "wo": RowParallelLinear(d, d, split_input=False, overlap=ov),
             "norm1": RMSNorm(d),
             "norm2": RMSNorm(d),
         }
@@ -331,7 +369,8 @@ class Transformer:
             mods.update({
                 "gate_proj": ColumnParallelLinear(d, f, gather_output=False),
                 "up_proj": ColumnParallelLinear(d, f, gather_output=False),
-                "down_proj": RowParallelLinear(f, d, split_input=False),
+                "down_proj": RowParallelLinear(f, d, split_input=False,
+                                               overlap=ov),
             })
         return mods
 
@@ -342,7 +381,9 @@ class Transformer:
     @functools.cached_property
     def lm_head(self) -> ColumnParallelLinear:
         # gather_output handled at the shard_map boundary; see module docstring.
-        return ColumnParallelLinear(self.d, self.vocab_padded, gather_output=False)
+        return ColumnParallelLinear(self.d, self.vocab_padded,
+                                    gather_output=False,
+                                    overlap=self.tp_overlap)
 
     # ---- init ----
 
@@ -470,12 +511,20 @@ class Transformer:
         # column-linears all-gather it back to the full local sequence t and
         # the row-linears reduce-scatter their outputs.
         sp = self.sequence_parallel
-        # Gather the normed activation ONCE per sublayer and share it between
-        # the projections (wq/wk/wv, gate/up): the fan-out cotangents sum at
-        # the single gather, whose transpose is one psum_scatter per sublayer
-        # (canonical Megatron SP traffic), not one per projection.
+        # tp_overlap='ring': the per-sublayer gather never materialises —
+        # the fused ring collective matmul (one ring SHARED by wq/wk/wv,
+        # resp. gate/up — same bytes as the shared gather) consumes the
+        # seq-sharded activation directly, and its custom VJP sums the
+        # fan-out cotangents on one reverse ring (the same one-psum_scatter
+        # -per-sublayer traffic as the shared gather's transpose).
+        ring_ov = sp and self.tp_overlap == "ring"
+        # Otherwise gather the normed activation ONCE per sublayer and share
+        # it between the projections (wq/wk/wv, gate/up): the fan-out
+        # cotangents sum at the single gather, whose transpose is one
+        # psum_scatter per sublayer (canonical Megatron SP traffic), not one
+        # per projection.
         maybe_gather = ((lambda z: gather_from(z, "tp", tiled_axis=-2))
-                        if sp else (lambda z: z))
+                        if sp and not ring_ov else (lambda z: z))
         in_layout = "gathered" if sp else "replicated"
         out_layout = "seq_sharded" if sp else "replicated"
         b = x.shape[0]
@@ -484,12 +533,17 @@ class Transformer:
         # Attention sublayer: x + attn(norm1(x))   (model.py:119)
         def qkv(x):
             y = maybe_gather(m["norm1"].apply(layer_params["norm1"], x))
-            q = m["wq"].apply(layer_params["wq"], y, dtype,
-                              input_layout=in_layout)
-            k = m["wk"].apply(layer_params["wk"], y, dtype,
-                              input_layout=in_layout)
-            v = m["wv"].apply(layer_params["wv"], y, dtype,
-                              input_layout=in_layout)
+            if ring_ov:
+                q, k, v = apply_column_ring_fused(
+                    (layer_params["wq"], layer_params["wk"],
+                     layer_params["wv"]), y, dtype)
+            else:
+                q = m["wq"].apply(layer_params["wq"], y, dtype,
+                                  input_layout=in_layout)
+                k = m["wk"].apply(layer_params["wk"], y, dtype,
+                                  input_layout=in_layout)
+                v = m["wv"].apply(layer_params["wv"], y, dtype,
+                                  input_layout=in_layout)
             # (b, t, heads*h) -> (b, heads, t, h); under grouped-query
             # attention wk/wv produce fewer heads and k/v STAY at the
             # kv-head count — every attention impl handles the grouping
@@ -527,21 +581,33 @@ class Transformer:
                     ff = lax.dynamic_slice_in_dim(
                         ff, lax.axis_index("tp") * tl, tl, axis=1)
                 return x + ff, aux
-            g = m["gate_proj"].apply(layer_params["gate_proj"], y, dtype,
-                                     input_layout=in_layout)
-            u = m["up_proj"].apply(layer_params["up_proj"], y, dtype,
-                                   input_layout=in_layout)
+            if ring_ov:
+                g, u = apply_column_ring_fused(
+                    (layer_params["gate_proj"], layer_params["up_proj"]),
+                    y, dtype)
+            else:
+                g = m["gate_proj"].apply(layer_params["gate_proj"], y, dtype,
+                                         input_layout=in_layout)
+                u = m["up_proj"].apply(layer_params["up_proj"], y, dtype,
+                                       input_layout=in_layout)
             x = x + m["down_proj"].apply(layer_params["down_proj"],
                                          jax.nn.silu(g) * u, dtype,
                                          output_layout=out_layout)
             return x, None
 
-        if live is None:
+        # Under ring overlap the dense segments run even on pipeline-bubble
+        # steps (live is ignored except by ring attention): their tp
+        # ppermutes lower with a GLOBAL participant list, so hiding them in
+        # a stage-divergent lax.cond would deadlock — the same constraint
+        # the cp ring documents below. Bubble steps burn the layer FLOPs;
+        # their outputs are structurally discarded (garbage flows only into
+        # garbage — see _pipeline_layers).
+        if live is None or ring_ov:
             q, k, v = qkv(x)
             if self.cp_size > 1:
                 if self.cp_impl == "ring":
                     o = ring_attention(q, k, v, pos, axis="cp",
-                                       impl=self.attn_impl)
+                                       impl=self.attn_impl, live=live)
                 else:
                     o = ulysses_attention(q, k, v, axis="cp",
                                           impl=self.attn_impl)
@@ -771,7 +837,16 @@ class Transformer:
 
         aux0 = (jax.tree.map(pvary, aux_zeros(self.cfg.num_experts))
                 if self.is_moe else None)
-        ring_cp = self.cp_size > 1 and self.cp_impl == "ring"
+        # Bubble-step execution mode: a whole-stage lax.cond is only sound
+        # when the layer body contains no ppermute (see pipe_step below).
+        # Two features put ppermutes in the body: the cp ring, and the
+        # tp_overlap ring collective matmuls — either forces the
+        # run-unconditionally mode, where the layer body itself decides what
+        # to gate (the cp ring gates per-block MXU work on `live`; the tp
+        # rings run in full, burning bubble FLOPs whose outputs are
+        # structurally discarded).
+        ring_cp = (self.cp_size > 1 and self.cp_impl == "ring") or (
+            self.sequence_parallel and self.tp_overlap == "ring")
 
         if self.pp_schedule == "interleaved":
             return self._pipeline_interleaved(

@@ -31,6 +31,41 @@ CHIP_SPECS = {
     "v6e": (918e12, 1640e9),
 }
 
+# Per-chip ICI terms: (one-way per-link ring bandwidth bytes/s, per-hop
+# latency s). These are the alpha-beta model's two knobs per collective —
+# nominal values from the published interconnect specs; `calibrate_ici`
+# LEARNS the effective bandwidth from a measured all-reduce p50 when the
+# bench took one on real hardware (the 4 MiB probe bench.py already runs),
+# so the comm attribution tracks the chip actually attached rather than
+# the datasheet.
+ICI_SPECS = {
+    "v5e": (4.5e10, 1e-6),
+    "v5p": (9.0e10, 1e-6),
+    "v4": (4.5e10, 1e-6),
+    "v6e": (9.0e10, 1e-6),
+}
+
+ALLREDUCE_PROBE_BYTES = 4 * 2**20  # metrics.allreduce_p50_us's payload
+
+
+def calibrate_ici(chip: str, n: int,
+                  measured_allreduce_us: Optional[float] = None,
+                  probe_bytes: int = ALLREDUCE_PROBE_BYTES):
+    """(ici_bw, ici_lat) for `chip` — the ICI_SPECS entry, with the
+    bandwidth term re-fit from a measured ring all-reduce p50 when one is
+    available: t = 2(n-1)/n * bytes / bw + 2(n-1) * lat  =>  bw. The
+    latency model (2(n-1) hops: reduce-scatter phase + all-gather phase)
+    matches how `comm_attribution` prices all-reduce records, so
+    re-pricing the probe collective with the fitted terms reproduces the
+    measurement. This is the 'learned ICI term': one measured collective
+    pins the line the whole comm attribution is priced on."""
+    bw, lat = ICI_SPECS.get(chip, ICI_SPECS["v5e"])
+    if measured_allreduce_us and n > 1:
+        wire = measured_allreduce_us * 1e-6 - 2 * (n - 1) * lat
+        if wire > 0:
+            bw = 2 * (n - 1) / n * probe_bytes / wire
+    return bw, lat
+
 
 def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
@@ -175,16 +210,165 @@ def analytic_phases(cfg, batch: int, t: int, remat: str = "dots",
     return phases
 
 
+def ring_chunk_bytes(cfg, batch: int, t: int, tp: int) -> Dict[str, float]:
+    """The ring collective-matmul chunk schedule's ppermute bytes per
+    DEVICE (tp_overlap='ring'), itemised so `--introspect` can cross-check
+    the HLO's collective-permute byte count against it.
+
+    Per ring instance the wire carries (n-1) hops of one (b, t/n, d) chunk
+    = (n-1)/n * b*t*d*A bytes. Per layer: fwd = 4 instances (qkv ring, wo
+    reduce ring, ffn ring, down reduce ring); bwd = 6 (each ag VJP runs a
+    re-gather ring + a reduce ring; each rs VJP one gather ring). The head
+    adds 1 fwd + 2 bwd. Both families share the schedule (gpt2's fc/proj
+    pair rings exactly like gate-up/down). NOTE for the HLO cross-check:
+    the layer stack is a lax.scan, so the compiled program TEXT contains
+    one layer's ring ops (executed num_layers times) — compare
+    `per_layer_*` against the HLO count, not `total_bytes`."""
+    A = 2 if "bf16" in str(cfg.compute_dtype) or "bfloat16" in str(
+        cfg.compute_dtype) else 4
+    u = (tp - 1) / tp * batch * t * cfg.attn_dim * A
+    return {"unit_bytes": u,
+            "per_layer_fwd_bytes": 4 * u,
+            "per_layer_bwd_bytes": 6 * u,
+            "head_fwd_bytes": u,
+            "head_bwd_bytes": 2 * u,
+            "total_bytes": cfg.num_layers * 10 * u + 3 * u}
+
+
+def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
+                     tp_overlap: str = "off", dp: int = 1,
+                     dp_bucket_mb: float = 0.0, dp_reduce_dtype: str = "f32",
+                     chip: str = "v5e", family: str = "llama",
+                     remat: str = "dots",
+                     measured_allreduce_us: Optional[float] = None,
+                     phase_ms: Optional[Dict[str, float]] = None) -> Dict:
+    """Per-collective comm attribution with an overlap model: how many ms
+    of ICI time the step spends, and how much of it HIDES under the matmul
+    each collective is (or could be) fused with.
+
+    Each record prices serialized_ms = bytes/ici_bw + hops*lat from the
+    learned ICI terms (`calibrate_ici`), then splits hidden vs exposed:
+
+    * tp act collectives, tp_overlap='ring' — hidden up to the ms of the
+      matmul sharing the ring (ag_matmul/matmul_rs overlap exactly that
+      pair); 'off' — the monolithic collective serialises fully.
+    * DP grad reduce, dp_bucket_mb > 0 — buckets issue during the
+      backward, hidden up to the backward's compute ms; 0 — the
+      end-of-step blob is fully exposed. bf16 wire halves its bytes.
+
+    `phase_ms` (name -> analytic ms from `analytic_phases`) supplies the
+    overlap budgets; computed here when omitted.
+    """
+    # the 4 MiB probe (`metrics.allreduce_p50_us`) rings over the tp axis,
+    # so the re-fit must solve for n = tp; the fitted per-link bandwidth
+    # then prices every axis's collectives
+    bw, lat = calibrate_ici(chip, tp,
+                            measured_allreduce_us if tp > 1 else None)
+    if phase_ms is None:
+        peak_flops, hbm_bw = CHIP_SPECS.get(chip, CHIP_SPECS["v5e"])
+        world = max(1, tp * dp)
+        phases = analytic_phases(cfg, batch, t, remat, family=family)
+        phase_ms = {p.name: p.ms(peak_flops * world, hbm_bw * world)
+                    for p in phases}
+
+    A = 2  # bf16 activation bytes, matching analytic_phases
+    L = cfg.num_layers
+    act = batch * t * cfg.attn_dim * A  # one full layer-boundary activation
+
+    def ms_of(nbytes: float, hops: int) -> float:
+        return (nbytes / bw + hops * lat) * 1e3
+
+    records = []
+
+    def add(name, kind, count, nbytes, hops, budget_ms, note=""):
+        total = count * ms_of(nbytes, hops)
+        hidden = min(total, budget_ms) if budget_ms > 0 else 0.0
+        records.append({
+            "name": name, "kind": kind, "count": count,
+            "bytes_each": nbytes, "serialized_ms": total,
+            "hidden_ms": hidden, "exposed_ms": total - hidden, "note": note})
+
+    if tp > 1:
+        ring = tp_overlap == "ring"
+        shard = (tp - 1) / tp * act     # ag / reduce-scatter wire bytes
+        ar = 2 * (tp - 1) / tp * act    # all-reduce wire bytes
+        hops = tp - 1
+        # budgets: the matmul each collective's ring is fused with (fwd),
+        # and its ~2x backward counterpart for the conjugate direction
+        fwd_note = ("ring: hops hide under the partial dots"
+                    if ring else "monolithic: fully exposed")
+        if sp:
+            # ring-mode counts follow `ring_chunk_bytes`'s chunk schedule:
+            # each ag VJP runs TWO reverse rings (re-gather + reduce) where
+            # the monolithic transpose is one conjugate collective, so the
+            # ring moves MORE chunk-instances per layer (4 fwd + 6 bwd vs
+            # 4 + 4) — all of them overlappable, but priced honestly
+            add("qkv all-gather (fwd+bwd)", "all-gather",
+                (3 if ring else 2) * L, shard, hops,
+                (phase_ms.get("qkv_proj", 0) * 3 if ring else 0), fwd_note)
+            add("wo reduce-scatter (fwd+bwd)", "reduce-scatter", 2 * L,
+                shard, hops,
+                (phase_ms.get("wo_proj", 0) * 3 if ring else 0), fwd_note)
+            add("ffn all-gather+reduce-scatter (fwd+bwd)", "all-gather",
+                (5 if ring else 4) * L, shard, hops,
+                (phase_ms.get("ffn", 0) * 3 if ring else 0), fwd_note)
+            add("lm_head all-gather (fwd+bwd)", "all-gather",
+                3 if ring else 2, shard, hops,
+                (phase_ms.get("lm_head", 0) * 3 if ring else 0), fwd_note)
+            add("embed reduce-scatter (fwd+bwd)", "reduce-scatter", 2,
+                shard, hops, 0.0, "bytes-bound producer; not ringed")
+        else:
+            add("per-sublayer all-reduce (fwd+bwd)", "all-reduce", 4 * L,
+                ar, 2 * hops, 0.0,
+                "no SP: monolithic psum per sublayer per direction")
+            add("lm_head input all-reduce (bwd)", "all-reduce", 1, ar,
+                2 * hops, 0.0, "copy_to transpose")
+        # vocab-parallel CE scalar-field psums: two (b, t) f32 fields
+        add("CE scalar psums (fwd+bwd)", "all-reduce", 2,
+            2 * (tp - 1) / tp * batch * t * 4, 2 * hops, 0.0,
+            "tiny; never worth overlapping")
+
+    if dp > 1:
+        P_count = cfg.num_params()
+        wire_itemsize = 2 if dp_reduce_dtype in ("bf16", "bfloat16") else 4
+        nbytes = 2 * (dp - 1) / dp * P_count * wire_itemsize
+        bucketed = dp_bucket_mb > 0
+        budget = phase_ms.get("backward", 0.0) if bucketed else 0.0
+        note = (f"bucketed ({dp_bucket_mb:g} MiB, {dp_reduce_dtype} wire): "
+                f"buckets overlap the remaining backward" if bucketed else
+                "end-of-step whole-tree blob: fully exposed "
+                "(--dp_reduce_bucket_mb to overlap)")
+        add("DP grad reduce", "all-reduce", 1, nbytes, 2 * (dp - 1),
+            budget, note)
+
+    total = sum(r["serialized_ms"] for r in records)
+    hidden = sum(r["hidden_ms"] for r in records)
+    return {"records": records,
+            "comm_total_ms": total,
+            "comm_hidden_ms": hidden,
+            "comm_exposed_ms": total - hidden,
+            "ici": {"bw_bytes_per_s": bw, "latency_s": lat,
+                    "calibrated": bool(measured_allreduce_us)},
+            "config": {"tp": tp, "sp": sp, "tp_overlap": tp_overlap,
+                       "dp": dp, "dp_bucket_mb": dp_bucket_mb,
+                       "dp_reduce_dtype": dp_reduce_dtype}}
+
+
 def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
                 t_real: Optional[int] = None,
                 block_q: Optional[int] = None,
                 block_k: Optional[int] = None,
                 measured: Optional[Dict[str, float]] = None,
                 chip: str = "v5e", world: int = 1,
-                family: str = "llama") -> Dict:
+                family: str = "llama", tp: int = 1, sp: bool = False,
+                tp_overlap: str = "off", dp: int = 1,
+                dp_bucket_mb: float = 0.0, dp_reduce_dtype: str = "f32",
+                measured_allreduce_us: Optional[float] = None) -> Dict:
     """The full report structure: analytic phase table, fwd/bwd/adam bucket
-    sums, ranked waste suspects, and (when `measured` carries bench.py
-    --breakdown components) analytic-vs-measured share columns.
+    sums, the per-collective COMM attribution (serialized vs hidden vs
+    exposed ICI ms under the configured overlap knobs), ranked waste
+    suspects, and (when `measured` carries bench.py --breakdown
+    components) analytic-vs-measured share columns.
 
     measured keys (all optional, ms): fwd_ms, fwdbwd_ms, step_ms,
     h2d_ms, and any 'step_ms_spdN'.
@@ -196,6 +380,13 @@ def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
                              family)
     by = {p.name: p for p in phases}
     ms = {p.name: p.ms(peak_flops, hbm_bw) for p in phases}
+    comm = comm_attribution(cfg, batch, t_real or t, tp=tp, sp=sp,
+                            tp_overlap=tp_overlap, dp=dp,
+                            dp_bucket_mb=dp_bucket_mb,
+                            dp_reduce_dtype=dp_reduce_dtype, chip=chip,
+                            family=family, remat=remat,
+                            measured_allreduce_us=measured_allreduce_us,
+                            phase_ms=ms)
     fwd_names = ["embed", "qkv_proj", "attention", "wo_proj", "ffn",
                  "norms_rope", "lm_head", "ce_loss"]
     buckets = {
@@ -246,6 +437,17 @@ def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
         "est_ms": ms["adam"],
         "note": "28 bytes/param HBM traffic",
     }]
+    if comm["comm_total_ms"] > 0:
+        cfg_note = comm["config"]
+        suspects.append({
+            "name": "exposed collective comm",
+            "est_ms": comm["comm_exposed_ms"],
+            "note": (f"{comm['comm_total_ms']:.2f} ms ICI total, "
+                     f"{comm['comm_hidden_ms']:.2f} hidden under compute "
+                     f"(tp_overlap={cfg_note['tp_overlap']}, "
+                     f"dp_bucket={cfg_note['dp_bucket_mb']:g}MiB); fix: "
+                     f"--tp_overlap ring / --dp_reduce_bucket_mb"),
+        })
     if step_ms > analytic_step:
         # The most important row when a measurement exists: whatever the
         # itemised suspects do NOT cover. A large value here means the gap
@@ -269,6 +471,7 @@ def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
 
     return {"phases": [dataclasses.asdict(p) | {"ms_est": ms[p.name]}
                        for p in phases],
+            "comm": comm,
             "buckets": buckets,
             "analytic_step_ms": analytic_step,
             "measured_step_ms": measured_step,
@@ -305,6 +508,24 @@ def format_attribution(report: Dict,
                                  ("adam", b["adam_ms"], madam)]:
         m = f"{meas:11.2f}" if meas is not None else "          —"
         lines.append(f"  {name:<12} {analytic:11.2f}   {m}")
+
+    comm = report.get("comm") or {}
+    if comm.get("comm_total_ms"):
+        ici = comm["ici"]
+        src = "calibrated" if ici["calibrated"] else "nominal"
+        lines.append(
+            f"  comm hidden / exposed: {comm['comm_hidden_ms']:.2f} / "
+            f"{comm['comm_exposed_ms']:.2f} ms "
+            f"(of {comm['comm_total_ms']:.2f} ms ICI, "
+            f"{src} {ici['bw_bytes_per_s']/1e9:.0f} GB/s + "
+            f"{ici['latency_s']*1e6:.1f}us/hop; "
+            f"tp_overlap={comm['config']['tp_overlap']}, "
+            f"dp_bucket={comm['config']['dp_bucket_mb']:g}MiB)")
+        for r in comm["records"]:
+            lines.append(
+                f"    {r['name']:<38} x{r['count']:<3} "
+                f"{r['serialized_ms']:6.2f} ms  hidden {r['hidden_ms']:6.2f}"
+                f"  exposed {r['exposed_ms']:6.2f}  {r['note']}")
 
     lines.append("  rank  suspect                        est_ms  share  note")
     for s in report["suspects"]:

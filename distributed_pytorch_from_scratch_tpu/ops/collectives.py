@@ -124,11 +124,37 @@ def all_to_all(x: jax.Array, axis: str, split_axis: int, concat_axis: int) -> ja
 
 
 def ring_permute(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
-    """Send to the next rank around the ring (ring/context parallelism)."""
+    """One ring hop over `axis` in an EXPLICIT direction.
+
+    `shift` is the perm direction, not an offset convenience: shift=+k
+    builds the forward ring perm [(i, (i+k) % n)] — rank i SENDS to i+k, so
+    after s hops of shift=+1 rank r HOLDS the value originated by rank
+    (r - s) mod n. shift=-k is the reverse ring. TPU ICI rings are
+    bidirectional, so both directions cost the same; the overlap kernels
+    (ops/overlap.py) pin shift=+1 for every hop — the all-gather ring walks
+    chunk origins DOWN (r-s) while the reduce ring walks accumulator
+    destinations UP (r + n-1-s), and both statements assume the forward
+    perm. Callers composing with them must use the same convention (the
+    ring-CP attention does: ops/ring_attention.py rotates k/v with
+    shift=+1). shift=0 would silently self-send; refused.
+    """
+    if shift == 0:
+        raise ValueError("ring_permute needs an explicit nonzero shift "
+                         "(direction); shift=0 would self-send every rank")
     n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
 
 def axis_index(axis: str = "tp") -> jax.Array:
+    """This shard's coordinate along `axis` (lax.axis_index).
+
+    Pipeline live-gating contract: the pp bubble predicates derive ONLY
+    from (pipeline step, axis_index('pp')) — never from data — so every
+    member of a tp/ep/sp group (which shares a pp stage, hence the same
+    index) agrees on the branch, keeping the collectives inside the live
+    branch uniform. Code that adds new gating must preserve this: a
+    predicate mixing in axis_index of a NON-pp axis would diverge within
+    the group and deadlock its collectives.
+    """
     return lax.axis_index(axis)

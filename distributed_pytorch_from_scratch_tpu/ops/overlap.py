@@ -1,0 +1,302 @@
+"""Communication-overlap kernels: ring-decomposed collective matmuls and
+bucketed gradient reduction.
+
+The Megatron collectives in `parallel/linear.py` are monolithic: a
+sequence-parallel column-linear all-gathers the FULL activation before the
+first MXU flop, and a row-linear blocks on a full psum_scatter after the
+last one — on a real mesh the ICI time is pure serial overhead. This module
+decomposes exactly those collectives so the wire hides under the matmul
+("On Optimizing the Communication of Model Parallelism", arXiv:2211.05322):
+
+* `ag_matmul(x, ws, axis)` — chunked all-gather-then-multiply. Each rank
+  starts from its local sequence chunk; every ring step issues the next
+  `ppermute` hop AND the partial dot of the chunk already in hand — two
+  ops with no data dependency, which XLA's latency-hiding scheduler runs
+  concurrently. `ws` is a TUPLE of weights sharing one ring (wq/wk/wv,
+  gate/up), so the fused path moves the same bytes as the single shared
+  all-gather it replaces.
+
+* `matmul_rs(x, w, axis)` — partial-dot-then-reduce-scatter, the same ring
+  in reverse: each step computes the partial product for the chunk whose
+  accumulator is about to arrive, and the add rides behind the hop.
+
+Both carry custom VJPs so the backward overlaps too: ag_matmul's dx is a
+matmul_rs ring (the conjugate), its dw re-gathers x chunks around the same
+ring; matmul_rs mirrors. Numerics: the ring accumulates partial sums in a
+fixed rank order, which is a DIFFERENT float summation order than
+psum_scatter's — equivalence against the monolithic path is allclose at
+the repo's standard tolerances, not bitwise (tests/test_overlap.py).
+
+Ring convention (see `ops.collectives.ring_permute`): shift=+1 sends rank
+i -> i+1, so after s forward hops rank r holds the chunk ORIGINATED by
+rank (r - s) mod n; the reduce ring forwards accumulators the same
+direction, with rank r at step s contributing to the chunk destined for
+rank (r + n-1-s) mod n.
+
+* `bucketed_psum(tree, axes, bucket_mb, reduce_dtype)` — DP/ZeRO-1
+  gradient reduction in size-bounded buckets instead of one end-of-step
+  blob: leaves are raveled + concatenated into <= bucket_mb buckets and
+  each bucket issues its own psum the moment its last cotangent exists in
+  the dataflow, so XLA can interleave the reductions with the remaining
+  backward compute. `reduce_dtype='bfloat16'` is the EQuARX-style
+  compressed variant (arXiv:2506.17615): the WIRE carries bf16, the
+  optimizer's f32 master accumulate is untouched (grads are cast back to
+  f32 after the reduce; no stochastic rounding).
+
+All ops MUST run inside `shard_map` code partitioned over `axis`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .collectives import ring_permute
+
+
+def _axis_size(axis: str) -> int:
+    return lax.axis_size(axis)  # static int: mesh shape is trace-time known
+
+
+def _check_2d(name: str, x: jax.Array) -> None:
+    if x.ndim < 2:
+        raise ValueError(f"{name} needs a (..., seq, feature) operand, got "
+                         f"shape {x.shape}")
+
+
+def _slot_slice(a: jax.Array, slot: jax.Array, tl: int) -> jax.Array:
+    """a[..., slot*tl : (slot+1)*tl, :] with a traced slot index."""
+    return lax.dynamic_slice_in_dim(a, slot * tl, tl, axis=-2)
+
+
+def _slot_update(a: jax.Array, upd: jax.Array, slot: jax.Array,
+                 tl: int) -> jax.Array:
+    return lax.dynamic_update_slice_in_dim(a, upd, slot * tl, axis=-2)
+
+
+# --------------------------------------------------------------- ag_matmul --
+
+def _ag_matmul_impl(x: jax.Array, ws: Tuple[jax.Array, ...],
+                    axis: str) -> Tuple[jax.Array, ...]:
+    """Ring all-gather-matmul forward: x (..., t/n, d) seq-sharded over
+    `axis`, each w (d, o_local) -> each y (..., t, o_local), equal to
+    `all_gather(x, axis, tiled over -2) @ w` up to summation order."""
+    n = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    tl = x.shape[-2]
+    outs = [jnp.zeros((*x.shape[:-2], tl * n, w.shape[-1]), x.dtype)
+            for w in ws]
+    chunk = x
+    for s in range(n):
+        # issue the hop FIRST: it has no dependency on this step's dots, so
+        # the scheduler overlaps the wire with the MXU work
+        nxt = ring_permute(chunk, axis, shift=1) if s < n - 1 else None
+        slot = jnp.mod(idx - s, n)  # origin rank of the chunk in hand
+        for j, w in enumerate(ws):
+            outs[j] = _slot_update(outs[j], chunk @ w, slot, tl)
+        chunk = nxt
+    return tuple(outs)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def ag_matmul(x: jax.Array, ws: Tuple[jax.Array, ...],
+              axis: str = "tp") -> Tuple[jax.Array, ...]:
+    """Fused all-gather-matmul over a ring.
+
+    `x` is this rank's (..., t/n, d) sequence chunk; `ws` a tuple of local
+    (d, o_j) weights sharing ONE ring (same bytes on the wire as a single
+    all-gather, however many weights consume it). Returns a tuple of
+    (..., t, o_j) full-sequence outputs. The custom VJP reduces the fan-out
+    cotangents on one reverse ring (dx) while re-gathering x chunks for the
+    weight grads on a second — both overlapped the same way as the forward.
+    """
+    _check_2d("ag_matmul", x)
+    if not isinstance(ws, (tuple, list)) or not ws:
+        raise ValueError("ag_matmul takes a non-empty tuple of weights "
+                         "(one ring shared by all of them)")
+    for w in ws:
+        if w.ndim != 2 or w.shape[0] != x.shape[-1]:
+            raise ValueError(
+                f"ag_matmul weight shape {w.shape} does not contract with "
+                f"x feature dim {x.shape[-1]}")
+    return _ag_matmul_impl(x, tuple(ws), axis)
+
+
+def _ag_matmul_fwd(x, ws, axis):
+    return _ag_matmul_impl(x, tuple(ws), axis), (x, tuple(ws))
+
+
+def _ag_matmul_bwd(axis, res, dys):
+    x, ws = res
+    n = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    tl = x.shape[-2]
+    bdims = tuple(range(x.ndim - 1))  # batch+seq dims to contract for dw
+
+    dx_acc = None
+    dws = [jnp.zeros_like(w) for w in ws]
+    chunk = x
+    for s in range(n):
+        nxt = ring_permute(chunk, axis, shift=1) if s < n - 1 else None
+        # dw ring: the chunk in hand originated at rank `slot`; it pairs
+        # with the cotangent rows of that same slot
+        slot = jnp.mod(idx - s, n)
+        # dx ring (the conjugate reduce-scatter): this step contributes the
+        # partial destined for rank `dest`, whose accumulator arrives next
+        dest = jnp.mod(idx + (n - 1 - s), n)
+        part = None
+        for j, (w, dy) in enumerate(zip(ws, dys)):
+            dy_slot = _slot_slice(dy, slot, tl)
+            dws[j] = dws[j] + jnp.tensordot(
+                chunk, dy_slot, axes=(bdims, bdims))
+            p = _slot_slice(dy, dest, tl) @ w.T
+            part = p if part is None else part + p
+        dx_acc = (part if s == 0
+                  else ring_permute(dx_acc, axis, shift=1) + part)
+        chunk = nxt
+    return dx_acc.astype(x.dtype), tuple(
+        dw.astype(w.dtype) for dw, w in zip(dws, ws))
+
+
+ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
+
+
+# --------------------------------------------------------------- matmul_rs --
+
+def _matmul_rs_impl(x: jax.Array, w: jax.Array, axis: str) -> jax.Array:
+    """Ring matmul-reduce-scatter forward: x (..., t, f_local), w
+    (f_local, o) -> (..., t/n, o), equal to
+    `psum_scatter(x @ w, axis, scatter over -2)` up to summation order."""
+    n = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    tl = x.shape[-2] // n
+    acc = None
+    for s in range(n):
+        dest = jnp.mod(idx + (n - 1 - s), n)
+        part = _slot_slice(x, dest, tl) @ w
+        # the hop and the next step's dot are independent: wire hides
+        acc = part if s == 0 else ring_permute(acc, axis, shift=1) + part
+    return acc
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def matmul_rs(x: jax.Array, w: jax.Array, axis: str = "tp") -> jax.Array:
+    """Fused matmul-reduce-scatter over a ring (the ag_matmul conjugate).
+
+    `x` holds this rank's partial-product input over the FULL sequence,
+    `w` the local (f, o) weight; the result is this rank's summed (t/n)
+    sequence chunk. Refuses a sequence length the ring cannot chunk evenly
+    — pick a t divisible by the axis size (same constraint as
+    `sequence_parallel` itself).
+    """
+    _check_2d("matmul_rs", x)
+    n = _axis_size(axis)
+    if x.shape[-2] % n != 0:
+        raise ValueError(
+            f"matmul_rs: sequence length {x.shape[-2]} not divisible by "
+            f"axis {axis!r} size {n} — the ring needs even chunks")
+    if w.ndim != 2 or w.shape[0] != x.shape[-1]:
+        raise ValueError(
+            f"matmul_rs weight shape {w.shape} does not contract with x "
+            f"feature dim {x.shape[-1]}")
+    return _matmul_rs_impl(x, w, axis)
+
+
+def _matmul_rs_fwd(x, w, axis):
+    return _matmul_rs_impl(x, w, axis), (x, w)
+
+
+def _matmul_rs_bwd(axis, res, dy):
+    x, w = res
+    n = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    tl = x.shape[-2] // n
+    bdims = tuple(range(x.ndim - 1))
+
+    dx = jnp.zeros_like(x)
+    dw = jnp.zeros_like(w)
+    chunk = dy  # (..., t/n, o): ring-gather the cotangent chunks
+    for s in range(n):
+        nxt = ring_permute(chunk, axis, shift=1) if s < n - 1 else None
+        slot = jnp.mod(idx - s, n)
+        dx = _slot_update(dx, (chunk @ w.T).astype(x.dtype), slot, tl)
+        dw = dw + jnp.tensordot(_slot_slice(x, slot, tl), chunk,
+                                axes=(bdims, bdims))
+        chunk = nxt
+    return dx, dw.astype(w.dtype)
+
+
+matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
+
+
+# ------------------------------------------------------ bucketed reduction --
+
+def bucket_partition(sizes: Sequence[int], bucket_bytes: int,
+                     itemsize: int = 4) -> "list[list[int]]":
+    """Group leaf indices into consecutive buckets of <= bucket_bytes each
+    (a single leaf larger than the bound gets its own bucket). Deterministic
+    in tree order so every shard builds the identical schedule."""
+    buckets, cur, cur_bytes = [], [], 0
+    for i, size in enumerate(sizes):
+        nbytes = size * itemsize
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def bucketed_psum(tree, axes, bucket_mb: float = 25.0,
+                  reduce_dtype=None):
+    """psum a pytree over `axes` in size-bounded buckets.
+
+    Value-equivalent to `jax.tree.map(lambda g: lax.psum(g, axes), tree)`
+    but issues one flattened psum per <= bucket_mb bucket: each bucket's
+    collective depends only on its own leaves, so it can launch as soon as
+    the backward has produced them and overlap with the rest of the
+    backward — instead of one whole-tree blob at the end of the step.
+
+    `reduce_dtype` (e.g. jnp.bfloat16) compresses the WIRE only: buckets
+    cast down before the psum and back to their original dtype after, so
+    the optimizer's f32 master accumulate still sees f32 grads (EQuARX-
+    style; adds one bf16 rounding per grad element plus the reduced-
+    precision accumulation across the `axes` ranks).
+    """
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    if not axes:
+        return tree
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    # buckets never mix dtypes (concatenate would silently promote); grads
+    # are uniformly f32 here, but the grouping keeps the op total
+    by_dtype: "dict[str, list[int]]" = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.dtype(leaf.dtype).name, []).append(i)
+    buckets = []
+    for idxs in by_dtype.values():
+        itemsize = leaves[idxs[0]].dtype.itemsize
+        for group in bucket_partition([leaves[i].size for i in idxs],
+                                      int(bucket_mb * 2**20), itemsize):
+            buckets.append([idxs[g] for g in group])
+    out = [None] * len(leaves)
+    for idxs in buckets:
+        flat = jnp.concatenate([leaves[i].ravel() for i in idxs])
+        if reduce_dtype is not None:
+            reduced = lax.psum(flat.astype(reduce_dtype), axes)
+            reduced = reduced.astype(flat.dtype)
+        else:
+            reduced = lax.psum(flat, axes)
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = reduced[off:off + n].reshape(leaves[i].shape)
+            off += n
+    return jax.tree.unflatten(treedef, out)

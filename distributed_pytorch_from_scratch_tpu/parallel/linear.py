@@ -36,8 +36,17 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops.collectives import (copy_to, gather_from, reduce_from,
                                reduce_scatter, split_to)
+from ..ops.overlap import ag_matmul, matmul_rs
 
 Params = Dict[str, Any]
+
+OVERLAP_MODES = ("off", "ring")
+
+
+def _check_overlap(overlap: str) -> None:
+    if overlap not in OVERLAP_MODES:
+        raise ValueError(f"overlap must be one of {OVERLAP_MODES}, "
+                         f"got {overlap!r}")
 
 
 def _torch_linear_init(key: jax.Array, idim: int, odim: int) -> jax.Array:
@@ -62,6 +71,14 @@ class ColumnParallelLinear:
     add_bias: bool = True
     gather_output: bool = True
     axis: str = "tp"
+    # 'ring' decomposes the sequence-parallel input all-gather into a ring
+    # collective matmul (ops/overlap.ag_matmul): each ppermute hop overlaps
+    # with the partial dot of the chunk already in hand. Only the
+    # input_layout='seq_sharded' path changes; 'off' stays bit-identical.
+    overlap: str = "off"
+
+    def __post_init__(self):
+        _check_overlap(self.overlap)
 
     def init(self, key: jax.Array) -> Params:
         p: Params = {"weight": _torch_linear_init(key, self.idim, self.odim)}
@@ -78,6 +95,13 @@ class ColumnParallelLinear:
     def apply(self, params: Params, x: jax.Array,
               compute_dtype: jnp.dtype = jnp.float32,
               input_layout: str = "replicated") -> jax.Array:
+        w = params["weight"].astype(compute_dtype)      # local (idim, odim/n)
+        if input_layout == "seq_sharded" and self.overlap == "ring":
+            # ring collective matmul: the gather's ppermute hops hide under
+            # the per-chunk partial dots; the custom VJP rings the backward
+            # too (matmul_rs for dx, a re-gather ring for dw).
+            y = ag_matmul(x.astype(compute_dtype), (w,), self.axis)[0]
+            return self._epilogue(params, y, compute_dtype)
         if input_layout == "replicated":
             x = copy_to(x, self.axis)                   # bwd: all-reduce input grads
         elif input_layout == "seq_sharded":
@@ -93,8 +117,11 @@ class ColumnParallelLinear:
             pass
         else:
             raise ValueError(f"unknown input_layout {input_layout!r}")
-        w = params["weight"].astype(compute_dtype)      # local (idim, odim/n)
         y = x.astype(compute_dtype) @ w
+        return self._epilogue(params, y, compute_dtype)
+
+    def _epilogue(self, params: Params, y: jax.Array,
+                  compute_dtype) -> jax.Array:
         if self.add_bias:
             y = y + params["bias"].astype(compute_dtype)
         if self.gather_output:
@@ -117,6 +144,14 @@ class RowParallelLinear:
     add_bias: bool = True
     split_input: bool = True
     axis: str = "tp"
+    # 'ring' decomposes the sequence-parallel output reduce-scatter into a
+    # ring collective matmul (ops/overlap.matmul_rs): partial dots feed the
+    # reduce ring chunk by chunk instead of blocking on one psum_scatter.
+    # Only the output_layout='seq_sharded' path changes; 'off' is today's.
+    overlap: str = "off"
+
+    def __post_init__(self):
+        _check_overlap(self.overlap)
 
     def init(self, key: jax.Array) -> Params:
         p: Params = {"weight": _torch_linear_init(key, self.idim, self.odim)}
@@ -136,17 +171,43 @@ class RowParallelLinear:
         if self.split_input:
             x = split_to(x, self.axis)                  # (.., idim) -> (.., idim/n)
         w = params["weight"].astype(compute_dtype)      # local (idim/n, odim)
-        y = x.astype(compute_dtype) @ w
-        if output_layout == "replicated":
-            y = reduce_from(y, self.axis)               # sum partial products
+        if output_layout == "seq_sharded" and self.overlap == "ring":
+            # ring collective matmul: per-chunk partial dots interleave with
+            # the reduce ring's hops instead of one blocking psum_scatter
+            y = matmul_rs(x.astype(compute_dtype), w, self.axis)
+        elif output_layout == "replicated":
+            y = reduce_from(x.astype(compute_dtype) @ w, self.axis)
         elif output_layout == "seq_sharded":
             # Megatron sequence parallelism: reduce-scatter the partial sums
             # over the sequence dim — each shard keeps summed (b, t/n, odim).
             # Bias (full over odim) still applies per token, after the reduce
             # like the reference (`layers.py:53-54`).
-            y = reduce_scatter(y, self.axis, scatter_axis=-2)
+            y = reduce_scatter(x.astype(compute_dtype) @ w, self.axis,
+                               scatter_axis=-2)
         else:
             raise ValueError(f"unknown output_layout {output_layout!r}")
         if self.add_bias:
             y = y + params["bias"].astype(compute_dtype)
         return y
+
+
+def apply_column_ring_fused(params_list, x: jax.Array, compute_dtype,
+                            axis: str = "tp"):
+    """Several column-parallel projections of ONE seq-sharded input on ONE
+    shared ring (wq/wk/wv, gate/up): the fused ag_matmul moves exactly the
+    bytes of the single shared all-gather the monolithic path uses, and the
+    custom VJP sums the fan-out cotangents on one reverse ring — the same
+    one-psum_scatter-per-sublayer traffic as the shared-gather transpose.
+
+    `params_list` is a sequence of ColumnParallelLinear param dicts (the
+    layers must all be gather_output=False, which the model pattern
+    guarantees). Returns one local (.., t, odim/n) output per entry.
+    """
+    ws = tuple(p["weight"].astype(compute_dtype) for p in params_list)
+    ys = ag_matmul(x.astype(compute_dtype), ws, axis)
+    out = []
+    for p, y in zip(params_list, ys):
+        if "bias" in p:
+            y = y + p["bias"].astype(compute_dtype)
+        out.append(y)
+    return out

@@ -76,9 +76,27 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="Megatron-style SP: shard inter-block activations "
                         "over the tp axis (reduce-scatter/all-gather instead "
                         "of all-reduce)")
+    g.add_argument("--tp_overlap", choices=["off", "ring"], default="off",
+                   help="'ring' decomposes the SP tp collectives into ring "
+                        "collective matmuls (ops/overlap.py): each ppermute "
+                        "hop hides under the partial dot of the chunk in "
+                        "hand, fwd and bwd; requires --sequence_parallel. "
+                        "'off' stays bit-identical to the monolithic path")
     g.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard Adam moments over the dp axis "
                         "(2/dp optimizer memory per device)")
+    g.add_argument("--dp_reduce_bucket_mb", type=float, default=0.0,
+                   help="bucketed DP/ZeRO-1 gradient reduction: issue one "
+                        "psum per <= N-MiB bucket (overlappable with the "
+                        "remaining backward) instead of the end-of-step "
+                        "whole-tree blob; 0 = off (the default transpose-"
+                        "derived reducer). Dense models, --pp_size 1")
+    g.add_argument("--dp_reduce_dtype", choices=["f32", "bf16"],
+                   default="f32",
+                   help="wire dtype for the bucketed DP grad reduce: 'bf16' "
+                        "halves the reduction bytes (EQuARX-style; the "
+                        "optimizer still accumulates f32 masters). Needs "
+                        "--dp_reduce_bucket_mb > 0")
     g.add_argument("--ep_size", type=int, default=1,
                    help="expert-parallel axis size (MoE: experts shard over "
                         "'ep'; requires --num_experts; 'ep' also shards the "
@@ -401,12 +419,25 @@ def train(args: argparse.Namespace) -> dict:
                       f"tiles; CE masks the pad targets; tok/s and MFU "
                       f"count real tokens)")
         attn_t_real = maxlen if t_bucket else None
+        if args.dp_reduce_dtype == "bf16" and not args.dp_reduce_bucket_mb:
+            raise SystemExit("--dp_reduce_dtype bf16 needs "
+                             "--dp_reduce_bucket_mb > 0 (the compressed "
+                             "wire is a property of the bucketed reducer)")
+        if args.dp_reduce_bucket_mb and args.pp_size > 1:
+            raise SystemExit("--dp_reduce_bucket_mb needs --pp_size 1 "
+                             "(pp-replicated leaves' reduction axes depend "
+                             "on the pipeline head layout)")
+        if args.dp_reduce_bucket_mb and cfg.num_experts:
+            raise SystemExit("--dp_reduce_bucket_mb does not compose with "
+                             "MoE (expert grads are ep-sharded, not "
+                             "batch-replicated)")
         if args.family == "gpt2":
             from .models.gpt2 import GPT2Transformer
             model = GPT2Transformer(cfg, tp_size=args.tp_size,
                                     cp_size=args.cp_size, cp_impl=args.cp_impl,
                                     cp_layout=args.cp_layout,
                                     sequence_parallel=args.sequence_parallel,
+                                    tp_overlap=args.tp_overlap,
                                     ep_size=args.ep_size, pp_size=args.pp_size,
                                     pp_microbatches=args.pp_microbatches,
                                     pp_remat_steps=args.pp_remat_steps,
@@ -419,6 +450,7 @@ def train(args: argparse.Namespace) -> dict:
                             cp_size=args.cp_size, cp_impl=args.cp_impl,
                             cp_layout=args.cp_layout,
                             sequence_parallel=args.sequence_parallel,
+                            tp_overlap=args.tp_overlap,
                             ep_size=args.ep_size, pp_size=args.pp_size,
                             pp_microbatches=args.pp_microbatches,
                             pp_remat_steps=args.pp_remat_steps,
@@ -509,7 +541,11 @@ def train(args: argparse.Namespace) -> dict:
                   f"recompile (pick a divisible pair to avoid it)")
         builder_kwargs = dict(zero1=args.zero1,
                               moment_shardings=moment_sh if args.zero1 else None,
-                              with_grad_norm=True)
+                              with_grad_norm=True,
+                              dp_reduce_bucket_mb=args.dp_reduce_bucket_mb,
+                              dp_reduce_dtype=(jnp.bfloat16
+                                               if args.dp_reduce_dtype == "bf16"
+                                               else None))
         if accum > 1:
             step_fn = build_grad_accum_step(model, mesh, ocfg, args.loss_mode,
                                             **builder_kwargs)

@@ -20,11 +20,25 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import OptimizerConfig, TrainConfig
 from ..models.transformer import Transformer
 from .optim import AdamState, adam_update, global_norm
-from .zero import zero1_moment_shardings
+from .zero import build_bucketed_grad_fn, zero1_moment_shardings
+
+
+def _make_grad_fn(model: Transformer, mesh, loss_mode: str,
+                  dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None):
+    """(params, ids, tgt, pos) -> (loss, grads): the transpose-derived
+    whole-tree reducer by default; with dp_reduce_bucket_mb > 0 the
+    bucketed-overlap reducer (training/zero.build_bucketed_grad_fn — DP
+    psums issued per size-bounded bucket, optionally bf16 on the wire)."""
+    if dp_reduce_bucket_mb:
+        return build_bucketed_grad_fn(model, mesh, loss_mode,
+                                      bucket_mb=dp_reduce_bucket_mb,
+                                      reduce_dtype=dp_reduce_dtype)
+    return jax.value_and_grad(model.make_loss(mesh, mode=loss_mode))
 
 
 def _step_body(model: Transformer, mesh, ocfg: OptimizerConfig,
-               loss_mode: str, with_grad_norm: bool = False):
+               loss_mode: str, with_grad_norm: bool = False,
+               dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None):
     """The one train-step body shared by both builders: grad + Adam/OneCycle.
     Keeping it single-sourced means the scanned (multi-step) program can
     never silently diverge from the per-step one.
@@ -33,7 +47,8 @@ def _step_body(model: Transformer, mesh, ocfg: OptimizerConfig,
     `(loss, grad_norm)` instead of `loss` — computed on-device inside the
     same program, fetched only at the loop's logging-interval D2H, so the
     sentinel costs no extra syncs."""
-    grad_fn = jax.value_and_grad(model.make_loss(mesh, mode=loss_mode))
+    grad_fn = _make_grad_fn(model, mesh, loss_mode,
+                            dp_reduce_bucket_mb, dp_reduce_dtype)
 
     def step(params, opt_state: AdamState, input_ids, target_ids,
              position_ids):
@@ -89,14 +104,21 @@ def _jit_with_zero1(fn, model, mesh, zero1, moment_shardings, loss_sharding):
 def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
                      loss_mode: str = "vocab_parallel",
                      zero1: bool = False, moment_shardings=None,
-                     with_grad_norm: bool = False):
+                     with_grad_norm: bool = False,
+                     dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None):
     """Returns jitted
     (params, opt_state, input_ids, target_ids, position_ids)
       -> (params, opt_state, loss)            [default]
       -> (params, opt_state, (loss, gnorm))   [with_grad_norm=True]
+
+    `dp_reduce_bucket_mb > 0` swaps the whole-tree DP grad reduction for
+    the bucketed-overlap reducer (with `dp_reduce_dtype=jnp.bfloat16` for
+    a compressed wire) — see training/zero.build_bucketed_grad_fn.
     """
     step = _step_body(model, mesh, ocfg, loss_mode,
-                      with_grad_norm=with_grad_norm)
+                      with_grad_norm=with_grad_norm,
+                      dp_reduce_bucket_mb=dp_reduce_bucket_mb,
+                      dp_reduce_dtype=dp_reduce_dtype)
     out_spec = (P(), P()) if with_grad_norm else P()
     return _jit_with_zero1(step, model, mesh, zero1, moment_shardings,
                            out_spec)
@@ -105,7 +127,9 @@ def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
 def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
                            loss_mode: str = "vocab_parallel",
                            zero1: bool = False, moment_shardings=None,
-                           with_grad_norm: bool = False):
+                           with_grad_norm: bool = False,
+                           dp_reduce_bucket_mb: float = 0.0,
+                           dp_reduce_dtype=None):
     """Multi-step-per-dispatch variant: one jitted program runs
     `lax.scan` over a leading steps axis of the batch.
 
@@ -122,7 +146,9 @@ def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
     (`/root/reference/train.py:94-109`).
     """
     step = _step_body(model, mesh, ocfg, loss_mode,
-                      with_grad_norm=with_grad_norm)
+                      with_grad_norm=with_grad_norm,
+                      dp_reduce_bucket_mb=dp_reduce_bucket_mb,
+                      dp_reduce_dtype=dp_reduce_dtype)
 
     def multi_step(params, opt_state: AdamState, input_ids, target_ids,
                    position_ids):
@@ -143,7 +169,9 @@ def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
 def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
                           loss_mode: str = "vocab_parallel",
                           zero1: bool = False, moment_shardings=None,
-                          with_grad_norm: bool = False):
+                          with_grad_norm: bool = False,
+                          dp_reduce_bucket_mb: float = 0.0,
+                          dp_reduce_dtype=None):
     """Gradient accumulation: ONE optimizer step from the MEAN of the
     microbatch gradients.
 
@@ -158,7 +186,8 @@ def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
     without scaling HBM. The reference has no accumulation (SURVEY
     non-goals); this is the TPU-native extension of its loop.
     """
-    grad_fn = jax.value_and_grad(model.make_loss(mesh, mode=loss_mode))
+    grad_fn = _make_grad_fn(model, mesh, loss_mode,
+                            dp_reduce_bucket_mb, dp_reduce_dtype)
 
     def step(params, opt_state: AdamState, input_ids, target_ids,
              position_ids):

@@ -21,6 +21,8 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.overlap import bucketed_psum
+
 DP_AXIS = "dp"
 
 
@@ -55,3 +57,131 @@ def zero1_moment_shardings(model, mesh: Mesh) -> Any:
     specs = zero1_specs(model.specs(), shapes, mesh)
     return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                         is_leaf=lambda x: isinstance(x, P))
+
+
+# ------------------------------------------------- bucketed grad reduction --
+
+def _spec_axes(spec: P) -> set:
+    """Mesh axes a PartitionSpec shards over (entries may be axis names or
+    tuples of them)."""
+    out = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            out.add(a)
+    return out
+
+
+def build_bucketed_grad_fn(model, mesh: Mesh, loss_mode: str = "vocab_parallel",
+                           bucket_mb: float = 25.0, reduce_dtype=None):
+    """(params, ids, tgt, pos) -> (loss, grads) with the data-parallel
+    gradient reduction issued in size-bounded BUCKETS instead of the
+    shard_map transpose's end-of-step whole-tree blob.
+
+    How: the loss AND its gradient are taken per-shard (jax.value_and_grad
+    INSIDE one shard_map), so no automatic boundary reduction happens for
+    the grads; the batch-axis sums the transpose would have inserted are
+    issued explicitly by `ops.overlap.bucketed_psum` — one flattened psum
+    per <= bucket_mb bucket, each depending only on its own cotangents, so
+    XLA can launch it as soon as the backward produces them and hide the
+    wire under the remaining backward compute. `reduce_dtype`
+    (jnp.bfloat16) compresses the wire only; grads return to f32 before
+    the optimizer's master accumulate (EQuARX-style, no stochastic
+    rounding — tolerance bounds pinned in tests/test_overlap.py).
+
+    Which axes each leaf reduces over: the batch axes (dp/ep/cp — params
+    are replicated over them, data varies), plus 'tp' for tp-REPLICATED
+    leaves when sequence parallelism is on (norm gains / row-linear biases
+    then see only t/tp tokens per shard, so their local grads are partial
+    sums; without SP those grads are tp-invariant — identical on every
+    shard — and summing them would scale by tp). Value-parity with the
+    transpose's reduction is pinned in tests/test_overlap.py.
+
+    Legacy-jax note (this container's 0.4.x shard_map, check_rep=False):
+    the transpose of lax.psum is psum there, so per-shard cotangents
+    inflate by the axis-size product of every psum they cross. Under SP
+    (or tp=1) that product is UNIFORM across leaves — the batch-axis loss
+    psum plus the vocab-parallel CE's tp psum; every other SP collective
+    (all_gather / psum_scatter / ppermute) transposes value-correctly —
+    and the inflation is measured at trace time with a two-line probe and
+    divided out, instead of version-sniffing jax. Parity with the
+    whole-tree reducer is pinned in tests/test_overlap.py, which fails
+    loudly if a jax upgrade changes the transpose semantics.
+
+    Scope: dense models on pp=1 meshes, with sequence_parallel on
+    whenever tp > 1. MoE routes through ep-sharded expert params, pp
+    shards the layer stack, and the non-SP tp path crosses a psum per
+    row-linear (depth-dependent inflation) — all need per-leaf variance
+    bookkeeping the static spec cannot express; the default whole-tree
+    path handles them.
+    """
+    if model.is_moe:
+        raise ValueError(
+            "bucketed DP grad reduction does not compose with MoE: expert "
+            "grads are ep-sharded, not batch-replicated — use the default "
+            "reducer")
+    if model.pp_size > 1:
+        raise ValueError(
+            "bucketed DP grad reduction requires pp_size == 1: non-layer "
+            "params are pp-replicated and their reduction axes depend on "
+            "the pipeline head layout — use the default reducer")
+    if model.tp_size > 1 and not model.sequence_parallel:
+        raise ValueError(
+            "bucketed DP grad reduction with tp > 1 requires "
+            "sequence_parallel: the non-SP path all-reduces inside every "
+            "row-parallel layer, so per-shard cotangent bookkeeping is "
+            "depth-dependent — use the default reducer (or turn SP on)")
+    specs = model.specs()
+    batch_axes = ("dp", "ep", "cp")
+    sp = model.sequence_parallel
+    leaf_specs = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+
+    def shard_fn(params, input_ids, target_ids, position_ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.loss_shard(p, input_ids, target_ids,
+                                       position_ids, mode=loss_mode))(params)
+        # Measure (don't version-sniff) the per-shard cotangent inflation:
+        # each probe differentiates a bare psum over the crossed axes, so
+        # it returns the axis-size product under the legacy
+        # psum-transposes-to-psum semantics and 1.0 wherever the transpose
+        # is value-preserving. Every leaf crosses the batch-axis loss psum
+        # and the CE's tp psum exactly once (the SP/tp=1 scope guarantees
+        # no others), so the correction is one uniform scalar —
+        # constant-folded by XLA.
+        k = (jax.grad(lambda z: jax.lax.psum(z, batch_axes))(1.0)
+             * jax.grad(lambda z: jax.lax.psum(z, ("tp",)))(1.0))
+        grads = jax.tree.map(lambda g: g / k, grads)
+        flat, treedef = jax.tree.flatten(grads)
+        assert len(flat) == len(leaf_specs)
+        groups: "dict[tuple, list[int]]" = {}
+        for i, spec in enumerate(leaf_specs):
+            axes = batch_axes
+            if sp and "tp" not in _spec_axes(spec):
+                axes = batch_axes + ("tp",)
+            groups.setdefault(axes, []).append(i)
+        out = list(flat)
+        for axes, idxs in groups.items():
+            reduced = bucketed_psum([flat[i] for i in idxs], axes,
+                                    bucket_mb=bucket_mb,
+                                    reduce_dtype=reduce_dtype)
+            for i, r in zip(idxs, reduced):
+                out[i] = r
+        return loss, jax.tree.unflatten(treedef, out)
+
+    batch_spec = P(("dp", "ep"), "cp")
+    fn = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=(specs, batch_spec, batch_spec, batch_spec),
+                       out_specs=(P(), specs))
+    if not model._zigzag:
+        return fn
+
+    from ..ops.ring_attention import zigzag_perm
+
+    def zz(params, input_ids, target_ids, position_ids):
+        # masked token-mean CE is permutation-invariant (make_loss's rule)
+        perm = zigzag_perm(input_ids.shape[1], model.cp_size)
+        return fn(params, input_ids[:, perm], target_ids[:, perm],
+                  position_ids[:, perm])
+
+    return zz

@@ -22,8 +22,10 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 R5 = os.path.join(REPO, "runs", "r5")
 
-# every staged session dir gets preflighted (r6 stages the fast-45m pass)
-SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"))
+# every staged session dir gets preflighted (r6 stages the fast-45m pass,
+# r7 the comm-overlap A/B)
+SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
+                            os.path.join(REPO, "runs", "r7"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
