@@ -140,10 +140,27 @@ def parse_args(argv=None):
                         "(/root/reference/test.py:141-161 recomputes the "
                         "whole prefix per token); vs_baseline = the speedup")
     p.add_argument("--prompt_len", type=int, default=64,
-                   help="--decode: tokens per prompt")
+                   help="--decode/--serving: tokens per prompt (serving "
+                        "draws lengths in [prompt_len/2, prompt_len])")
     p.add_argument("--gen_tokens", type=int, default=128,
-                   help="--decode: generation budget per prompt")
+                   help="--decode/--serving: generation budget per prompt")
+    p.add_argument("--serving", action="store_true",
+                   help="bench CONTINUOUS-BATCHING serving throughput "
+                        "(serving/engine.py): a burst of --serve_requests "
+                        "mixed-length requests through the slot-based "
+                        "engine vs the same set decoded by one-shot "
+                        "GreedyDecoder batches (vs_baseline = the "
+                        "continuous-batching speedup); also reports "
+                        "TTFT/TPOT p50/p95 and slot occupancy")
+    p.add_argument("--slots", type=int, default=8,
+                   help="--serving: KV-pool slots (= the one-shot "
+                        "baseline's batch size, so the comparison is "
+                        "concurrency-controlled)")
+    p.add_argument("--serve_requests", type=int, default=24,
+                   help="--serving: requests in the burst")
     args = p.parse_args(argv)
+    if args.serving and (args.decode or args.breakdown):
+        p.error("--serving excludes --decode/--breakdown")
     if args.remat is None:
         args.remat = "dots" if args.model == "gpt2-355m" else "false"
     if args.analytic and not args.breakdown:
@@ -315,6 +332,96 @@ def run_decode_bench(args, mesh, cfg, tp: int) -> None:
         "probe_steps": probe_steps,
         "kv_rate_per_stream": round(kv_rate_stream, 1),
         "ref_recompute_rate": round(ref_rate, 1),
+    }))
+
+
+def run_serving_bench(args, mesh, cfg, tp: int) -> None:
+    """Continuous-batching serving throughput vs one-shot batch decode.
+
+    The SAME burst of mixed-length requests goes through (a) the serving
+    engine at --slots concurrency (slots retire and refill as rows finish)
+    and (b) one-shot GreedyDecoder batches of --slots rows (every batch
+    pads to the longest prompt and waits for its slowest row — today's
+    generate.py-before-this-PR behaviour). vs_baseline = a / b in
+    aggregate tokens/s. Random init + random-id prompts (cost depends on
+    shapes, not values). First-touch compiles are included in both sides'
+    walls; the engine's prefill variants are bounded by the bucket count.
+    """
+    import numpy as np
+
+    from distributed_pytorch_from_scratch_tpu.models.decode import (
+        GreedyDecoder)
+    from distributed_pytorch_from_scratch_tpu.serving.engine import (
+        ContinuousBatchingEngine)
+    from distributed_pytorch_from_scratch_tpu.serving.loadgen import (
+        run_loadgen, synthetic_requests)
+
+    plen, gen = args.prompt_len, args.gen_tokens
+    if plen < 3 or gen <= 0:
+        # loadgen prompts need >= 3 ids (the BOS/EOS/UNK convention floor)
+        raise SystemExit("--serving needs --prompt_len >= 3 and "
+                         "--gen_tokens >= 1")
+    if plen + gen + 2 > cfg.maxlen:
+        cfg = dataclasses.replace(cfg, maxlen=plen + gen + 2)
+    model = build_model(args, cfg, tp)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    buf_len = plen + gen + 2
+    eos = 1  # the shipped tokenizer's EOS (tokenizer/tokenizer.json)
+    requests = synthetic_requests(
+        args.serve_requests, max(3, plen // 2), plen, gen, cfg.vocab_size,
+        seed=2, arrival="burst")
+
+    engine = ContinuousBatchingEngine(
+        model, mesh, params, num_slots=args.slots, buf_len=buf_len,
+        eos_id=eos, prefill_bucket=128)
+    summary = run_loadgen(engine, requests)
+    serve_rate = summary["tokens_per_sec"]
+
+    # one-shot baseline: the same prompts in GreedyDecoder batches of
+    # --slots (the final ragged batch repeats its last prompt to keep one
+    # compiled shape; pad-row outputs are not counted)
+    dec = GreedyDecoder(model, mesh, buf_len)
+    prompts = [r.prompt for r in requests]
+    B = args.slots
+    t0 = time.time()
+    oneshot_tokens = 0
+    for i in range(0, len(prompts), B):
+        chunk = prompts[i:i + B]
+        real = len(chunk)
+        chunk = chunk + [chunk[-1]] * (B - real)
+        limits = np.asarray([len(p) + gen for p in chunk], np.int32)
+        gens = dec.decode_batch(params, chunk, eos, max_total_len=limits)
+        oneshot_tokens += sum(len(g) for g in gens[:real])
+    oneshot_s = time.time() - t0
+    oneshot_rate = oneshot_tokens / max(oneshot_s, 1e-9)
+
+    fmt = lambda v: "-" if v is None else f"{v:.0f}"
+    print(f"bench[serving {args.model} {args.family}]: "
+          f"{summary['completed']}/{summary['requests']} requests, "
+          f"slots={args.slots}, {serve_rate:.0f} tok/s continuous vs "
+          f"{oneshot_rate:.0f} tok/s one-shot batches "
+          f"({oneshot_tokens} tokens in {oneshot_s*1000:.0f}ms); TTFT "
+          f"p50/p95 {fmt(summary['ttft_ms_p50'])}/"
+          f"{fmt(summary['ttft_ms_p95'])}ms, occupancy "
+          f"{summary['slot_occupancy_mean']:.2f}", file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"serving tokens/sec ({args.model} {args.family}, "
+                   f"slots={args.slots}, {args.serve_requests}-request "
+                   f"burst, prompt<=~{plen}, gen {gen}; vs_baseline = "
+                   f"speedup over one-shot b{args.slots} GreedyDecoder "
+                   f"batches of the same request set)"),
+        "value": round(serve_rate, 1),
+        "unit": "tokens/sec (serving)",
+        "vs_baseline": round(serve_rate / max(oneshot_rate, 1e-9), 3),
+        "oneshot_rate": round(oneshot_rate, 1),
+        "slot_occupancy_mean": summary["slot_occupancy_mean"],
+        "ttft_ms_p50": summary["ttft_ms_p50"],
+        "ttft_ms_p95": summary["ttft_ms_p95"],
+        "tpot_ms_p50": summary["tpot_ms_p50"],
+        "tpot_ms_p95": summary["tpot_ms_p95"],
+        "prefill_pad_waste_eliminated":
+            summary["prefill_pad_waste_eliminated"],
     }))
 
 
@@ -623,10 +730,12 @@ def main(argv=None):
         args.remat = select_remat(cfg, default_batch(args),
                                   args.seqlen or cfg.maxlen,
                                   tp=tp, world=args.dp * tp)
-    if args.decode or args.breakdown:
-        if args.introspect and args.decode:
-            print("bench: --introspect does not apply to --decode; "
-                  "ignoring it", file=sys.stderr)
+    if args.decode or args.breakdown or args.serving:
+        if args.introspect and (args.decode or args.serving):
+            print("bench: --introspect does not apply to --decode/"
+                  "--serving; ignoring it", file=sys.stderr)
+        if args.serving:
+            return run_serving_bench(args, mesh, cfg, tp)
         if args.decode:
             return run_decode_bench(args, mesh, cfg, tp)
         return run_breakdown(args, mesh, cfg, tp)

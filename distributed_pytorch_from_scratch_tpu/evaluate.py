@@ -243,10 +243,29 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
                     f"({longest + 2} positions) under the learned position "
                     f"table ({cap}); reduce --cp_size or --max_decode_len")
 
-    if use_kv_cache:
-        # ONE device dispatch for the whole prompt set: decode_batch handles
-        # the mixed prompt lengths (models/decode.py). The reference loops
-        # prompts AND tokens (`test.py:141-161`).
+    if use_kv_cache and cp == 1:
+        # continuous-batching engine (serving/engine.py): the prompts
+        # prefill in length buckets and share one compiled decode step —
+        # token-identical to the fused GreedyDecoder for greedy decode
+        # (tests/test_serving.py), and the eval CLI exercises the same
+        # lowering production serving uses.
+        from .serving.engine import ContinuousBatchingEngine, decode_prompts
+
+        prompts = [[bos_id] + encoded[t] for t in texts]
+        engine = ContinuousBatchingEngine(
+            model, mesh, params, num_slots=min(len(prompts), 8),
+            buf_len=buf_len, eos_id=eos_id, temperature=temperature,
+            top_k=top_k, top_p=top_p)
+        # same TOTAL-length budget as the fused path's max_total_len
+        gens = decode_prompts(
+            engine, prompts,
+            [max(0, max_decode_len + 1 - len(pr)) for pr in prompts],
+            base_seed=seed)
+        decoded_texts = [tokenizer.decode(encoded[t] + gen).strip()
+                         for t, gen in zip(texts, gens)]
+    elif use_kv_cache:
+        # cp-sharded ring prefill: the fused whole-generation decoder
+        # (the serving engine decodes on the cp=1 path only)
         decoder = GreedyDecoder(model, mesh, buf_len,
                                 temperature=temperature, top_k=top_k,
                                 top_p=top_p)

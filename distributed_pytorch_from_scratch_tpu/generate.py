@@ -56,6 +56,17 @@ def get_generate_args(argv=None) -> argparse.Namespace:
     p.add_argument("--decode_top_k", type=int, default=0)
     p.add_argument("--decode_top_p", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prefill_bucket", type=int, default=64,
+                   help="serving-engine prefill width bucket: each prompt "
+                        "prefills over a buffer padded to a multiple of "
+                        "this instead of the whole decode buffer (identical "
+                        "tokens — causal attention makes the width a pure "
+                        "cost knob); 0 pads to the full buffer. cp decode "
+                        "(--cp_size > 1) uses the fused decoder and "
+                        "ignores this")
+    p.add_argument("--slots", type=int, default=8,
+                   help="serving-engine KV slots (concurrent decodes); "
+                        "prompts beyond this queue FIFO")
     args = p.parse_args(argv)
     if (args.decode_top_k or args.decode_top_p) and not args.temperature:
         p.error("--decode_top_k/--decode_top_p need --temperature > 0")
@@ -123,17 +134,41 @@ def generate(args: argparse.Namespace) -> list:
                     f"cp_size {args.cp_size} chunking cannot fit the prompt "
                     f"({longest + 2} positions) under the position table "
                     f"({cap})")
-    dec = GreedyDecoder(model, mesh, buf_len,
-                        temperature=args.temperature,
-                        top_k=args.decode_top_k, top_p=args.decode_top_p)
     prompts = [[bos_id] + e for e in encoded]
-    # per-ROW budget: each prompt generates at most max_new_tokens,
-    # regardless of how the batch's lengths mix (models/decode.py takes a
-    # (b,) total-length vector)
-    limits = np.asarray([len(p) + args.max_new_tokens for p in prompts],
-                        np.int32)
-    gens = dec.decode_batch(params, prompts, eos_id,
-                            max_total_len=limits, seed=args.seed)
+    if args.cp_size > 1:
+        # long-context path: the fused decoder's ring prefill (the serving
+        # engine decodes on the cp=1 path only)
+        dec = GreedyDecoder(model, mesh, buf_len,
+                            temperature=args.temperature,
+                            top_k=args.decode_top_k, top_p=args.decode_top_p)
+        # per-ROW budget: each prompt generates at most max_new_tokens,
+        # regardless of how the batch's lengths mix (models/decode.py takes
+        # a (b,) total-length vector)
+        limits = np.asarray([len(p) + args.max_new_tokens for p in prompts],
+                            np.int32)
+        gens = dec.decode_batch(params, prompts, eos_id,
+                                max_total_len=limits, seed=args.seed)
+    else:
+        # continuous-batching engine: mixed-length prompts prefill in
+        # length buckets instead of all padding to the longest+budget
+        # buffer (token-identical to GreedyDecoder for greedy decode —
+        # tests/test_serving.py pins it; sampled decode draws per-request)
+        from .serving.engine import ContinuousBatchingEngine, decode_prompts
+
+        engine = ContinuousBatchingEngine(
+            model, mesh, params, num_slots=min(len(prompts), args.slots),
+            buf_len=buf_len, eos_id=eos_id, temperature=args.temperature,
+            top_k=args.decode_top_k, top_p=args.decode_top_p,
+            prefill_bucket=args.prefill_bucket)
+        gens = decode_prompts(engine, prompts, args.max_new_tokens,
+                              base_seed=args.seed)
+        waste = engine.stats()["prefill_pad_waste_eliminated"]
+        if waste > 0:
+            print(f"prefill pad waste eliminated by length bucketing: "
+                  f"{100 * waste:.0f}% ({engine.prefill_positions} "
+                  f"bucketed positions vs "
+                  f"{engine.prefill_positions_monolithic} at the "
+                  f"full-buffer padding)")
     outs = []
     for text, ids, gen in zip(args.prompt, encoded, gens):
         full = tokenizer.decode(ids + gen).strip()

@@ -49,21 +49,16 @@ from typing import Dict, List
 import numpy as np
 
 from .config import ModelConfig
-from .training.checkpoint import CKPT_RE, find_rank_shards
+from .training.checkpoint import (CKPT_RE, find_rank_shards,
+                                  validate_checkpoint)
 
 
 def find_reference_shards(ckpt_dir: str, step: int) -> List[str]:
-    """Per-rank .pth paths for iteration `step`, ordered by rank."""
-    by_rank = find_rank_shards(ckpt_dir, step, ext="pth")
-    if not by_rank:
-        raise FileNotFoundError(
-            f"no reference checkpoint files for iter {step} in {ckpt_dir}")
-    ranks = sorted(by_rank)
-    if ranks != list(range(len(ranks))):
-        raise FileNotFoundError(
-            f"reference checkpoint iter {step} has ranks {ranks}; "
-            f"expected contiguous 0..{len(ranks) - 1}")
-    return [by_rank[r] for r in ranks]
+    """Per-rank .pth paths for iteration `step`, ordered by rank.
+    Completeness is validated up front (training/checkpoint.py) so a hole
+    in the rank set names the missing ranks instead of mis-assembling."""
+    tp_size, by_rank = validate_checkpoint(ckpt_dir, step, ext="pth")
+    return [by_rank[r] for r in range(tp_size)]
 
 
 def reference_iters(ckpt_dir: str) -> List[int]:

@@ -228,15 +228,36 @@ def _prefill_cp(model: Transformer, params: Params, buf: jax.Array,
 def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
                 token: jax.Array, cur: jax.Array, buf_len: int,
                 cos_t, sin_t, dtype):
-    """One single-token step at position `cur`: writes the token's K/V into
-    the caches, attends over cache[0..cur], returns (k', v', logits)."""
+    """One single-token step: writes each row's token K/V into the caches at
+    that row's position, attends over cache[0..cur_row], returns
+    (k', v', logits).
+
+    `cur` may be a scalar (the fused whole-generation loop's shared cursor)
+    or a (b,) vector (the serving engine's per-slot cursors — every live
+    slot sits at its own position). Per-row math is identical either way:
+    the scalar case is just the broadcast vector, so both drivers share
+    this one lowering."""
     b = token.shape[0]
-    p1 = jnp.full((b, 1), cur, jnp.int32)
+    shared_cur = jnp.ndim(cur) == 0   # static: the fused loop's scalar case
+    cur_scalar = cur
+    cur = jnp.broadcast_to(jnp.asarray(cur, jnp.int32), (b,))
+    p1 = cur[:, None]
     x = _embed(model, params, token[:, None], p1, dtype)
     if model.uses_rope:
         cos = jnp.take(cos_t, p1, axis=0, mode="clip")
         sin = jnp.take(sin_t, p1, axis=0, mode="clip")
-    visible = (jnp.arange(buf_len) <= cur)[None, None, None, :]
+    visible = (jnp.arange(buf_len)[None, :] <= cur[:, None])[:, None, None, :]
+    rows = jnp.arange(b)
+
+    def write_cache(cache, z):
+        # per-row scatter (row i writes position cur[i]); a SHARED scalar
+        # cursor keeps the old dynamic-update-slice lowering — cheaper on
+        # TPU than trusting XLA to pattern-match the all-equal scatter —
+        # with identical written values either way
+        if shared_cur:
+            return lax.dynamic_update_slice_in_dim(
+                cache, z.astype(cache.dtype), cur_scalar, axis=2)
+        return cache.at[rows, :, cur, :].set(z[:, :, 0, :].astype(cache.dtype))
 
     def body(x, layer_in):
         lp, k_cache, v_cache = layer_in
@@ -245,10 +266,8 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
         q, k, v = _qkv(model, lp, y, dtype)   # q: (b, h, 1, hd); kv: kvh
         if model.uses_rope:
             q, k = apply_rotary(q, k, cos, sin)
-        k_cache = lax.dynamic_update_slice_in_dim(
-            k_cache, k.astype(k_cache.dtype), cur, axis=2)
-        v_cache = lax.dynamic_update_slice_in_dim(
-            v_cache, v.astype(v_cache.dtype), cur, axis=2)
+        k_cache = write_cache(k_cache, k)
+        v_cache = write_cache(v_cache, v)
         # grouped attention against the kv-head caches: query head
         # kv_idx*g + g_idx reads kv head kv_idx (g == 1 reduces to plain
         # MHA — the reshapes are identities)
@@ -268,6 +287,88 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
 
     x, (k_new, v_new) = lax.scan(body, x, (params["layers"], cache_k, cache_v))
     return k_new, v_new, _logits_last(model, params, x, dtype)
+
+
+def validate_sampling(cfg, temperature: float, top_k: int,
+                      top_p: float) -> None:
+    """Build-time sampling-knob validation shared by `make_generate` and the
+    serving engine (serving/engine.py) — one contract, one error text."""
+    if temperature < 0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or top_k > cfg.vocab_size:
+        raise ValueError(f"top_k must be in [0, vocab_size], got {top_k}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1] (0 = off), got {top_p}")
+
+
+def _full_vocab_logits(model: Transformer, logits: jax.Array) -> jax.Array:
+    """Local vocab-shard logits -> full (b, vocab_size) f32 logits (gathers
+    the tp shards; every shard holds the same values afterwards)."""
+    full = gather_from(logits.astype(jnp.float32), "tp")
+    return full[:, : model.cfg.vocab_size]
+
+
+def _filter_logits(scaled: jax.Array, top_k: int, top_p: float) -> jax.Array:
+    """top-k then top-p (nucleus) filtering on temperature-scaled logits;
+    filtered-out entries become -inf. Both filters compose: top-k prunes
+    first, then top-p."""
+    if top_k:
+        # kth-largest threshold via top_k, not a full V-sort — this runs
+        # once per generated token
+        kth = lax.top_k(scaled, top_k)[0][:, -1][:, None]
+        scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+    if top_p and top_p < 1.0:
+        # nucleus: keep the smallest descending-prob prefix whose mass
+        # reaches top_p (the top token always survives: its own
+        # exclusive-cumsum is 0 < top_p)
+        sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
+        keep = cum < top_p                        # (b, V) sorted
+        # threshold = smallest kept logit, mapped back to the unsorted
+        # layout by value comparison
+        thresh = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+    return scaled
+
+
+def make_token_sampler(model: Transformer, temperature: float = 0.0,
+                       top_k: int = 0, top_p: float = 0.0):
+    """Per-ROW-seeded sampler for the serving engine: `sample(logits,
+    seeds, positions)` -> (b,) token ids, called INSIDE shard_map.
+
+    Greedy (temperature 0) ignores seeds/positions. Sampled rows draw with
+    key = fold_in(fold_in(key(0), seed_row), position_row): the draw is a
+    pure function of the REQUEST's seed and the absolute position the
+    token will occupy — independent of which slot the request landed in,
+    what else shares the batch, and when it was admitted, which is exactly
+    the reproducibility contract continuous batching needs. (The fused
+    `make_generate` keeps its own caller-key schedule; the filter and
+    gather lowerings are shared.)"""
+    validate_sampling(model.cfg, temperature, top_k, top_p)
+
+    def sample(logits: jax.Array, seeds: jax.Array,
+               positions: jax.Array) -> jax.Array:
+        full = _full_vocab_logits(model, logits)
+        if temperature == 0.0:
+            idx = jnp.argmax(full, axis=-1).astype(jnp.int32)
+        else:
+            scaled = _filter_logits(full / temperature, top_k, top_p)
+
+            def draw(seed, pos, row):
+                key = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.key(0), seed), pos)
+                return jax.random.categorical(key, row, axis=-1)
+
+            idx = jax.vmap(draw)(seeds.astype(jnp.uint32),
+                                 positions.astype(jnp.int32),
+                                 scaled).astype(jnp.int32)
+        # every tp shard computed the same choice; pmax clears the
+        # varying tag so downstream carries stay tp-invariant
+        return lax.pmax(idx, "tp")
+
+    return sample
 
 
 def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
@@ -306,12 +407,7 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
     # when buf_len > maxlen — ADVICE r1). Families with learned positions
     # instead hard-cap the buffer (GreedyDecoder validates).
     table_len = max(cfg.maxlen, buf_len)
-    if temperature < 0:
-        raise ValueError(f"temperature must be >= 0, got {temperature}")
-    if top_k < 0 or top_k > cfg.vocab_size:
-        raise ValueError(f"top_k must be in [0, vocab_size], got {top_k}")
-    if not 0.0 <= top_p <= 1.0:
-        raise ValueError(f"top_p must be in [0, 1] (0 = off), got {top_p}")
+    validate_sampling(cfg, temperature, top_k, top_p)
 
     def shard_fn(params, buf, prompt_len, eos_id, max_total_len, key):
         b, _ = buf.shape
@@ -333,31 +429,11 @@ def make_generate(model: Transformer, mesh: Mesh, buf_len: int,
             # gather the tp vocab shards; every shard then computes the
             # same choice (same key), and pmax clears the varying tag so
             # the buf carry stays tp-invariant
-            full = gather_from(logits.astype(jnp.float32), "tp")
-            full = full[:, : cfg.vocab_size]
+            full = _full_vocab_logits(model, logits)
             if temperature == 0.0:
                 idx = jnp.argmax(full, axis=-1).astype(jnp.int32)
             else:
-                scaled = full / temperature
-                if top_k:
-                    # kth-largest threshold via top_k, not a full V-sort —
-                    # this runs once per generated token in the fused loop
-                    kth = lax.top_k(scaled, top_k)[0][:, -1][:, None]
-                    scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
-                if top_p and top_p < 1.0:
-                    # nucleus: keep the smallest descending-prob prefix
-                    # whose mass reaches top_p (the top token always
-                    # survives: its own exclusive-cumsum is 0 < top_p)
-                    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
-                    probs = jax.nn.softmax(sorted_l, axis=-1)
-                    cum = jnp.cumsum(probs, axis=-1) - probs  # exclusive
-                    keep = cum < top_p                        # (b, V) sorted
-                    # threshold = smallest kept logit, mapped back to the
-                    # unsorted layout by value comparison
-                    thresh = jnp.min(
-                        jnp.where(keep, sorted_l, jnp.inf), axis=-1,
-                        keepdims=True)
-                    scaled = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+                scaled = _filter_logits(full / temperature, top_k, top_p)
                 idx = jax.random.categorical(
                     jax.random.fold_in(key, cur), scaled, axis=-1
                 ).astype(jnp.int32)

@@ -1,0 +1,22 @@
+"""Continuous-batching inference serving over the fused KV-cache decoder.
+
+The training side of this repo got its scale-out in PRs 1-4; this package
+opens the INFERENCE workload: a slot-granular KV-cache pool
+(`kv_manager.py`), a continuous-batching engine whose device programs share
+the one-shot decoder's lowerings (`engine.py` — greedy output is
+token-identical to `models/decode.GreedyDecoder`), a FIFO scheduler with
+length-bucketed prefill batching (`scheduler.py`), a Poisson/burst/replay
+arrival driver (`loadgen.py`), and the `serve.py` benchmark CLI. See
+docs/SERVING.md.
+"""
+
+from .engine import ContinuousBatchingEngine, Request, decode_prompts
+from .kv_manager import KVCachePool
+from .loadgen import run_loadgen, synthetic_requests
+from .scheduler import FIFOScheduler, QueueFull, bucket_width
+
+__all__ = [
+    "ContinuousBatchingEngine", "FIFOScheduler", "KVCachePool", "QueueFull",
+    "Request", "bucket_width", "decode_prompts", "run_loadgen",
+    "synthetic_requests",
+]

@@ -1,0 +1,389 @@
+"""Continuous-batching inference engine over the slot-granular KV pool.
+
+The one-shot decoder (`models/decode.GreedyDecoder`) fuses prefill + the
+whole generation loop into a single dispatch: perfect for a fixed prompt
+set, useless for serving — the batch pads to the slowest prompt and no new
+request can enter until every row retires. This engine inverts the control
+flow: the HOST drives a loop of small compiled programs, so between any two
+decode steps it can retire finished slots and prefill queued prompts into
+the freed cache rows. The device programs are built from the SAME lowering
+functions the fused decoder uses (`models/decode._prefill`, `_decode_one`,
+`make_token_sampler`), which is why continuous-batched greedy output is
+token-identical to per-prompt `GreedyDecoder` decode (pinned in
+tests/test_serving.py).
+
+Two compiled programs, both donating the pool so slot writes are in place:
+
+* **prefill** (one variant per (batch, width) bucket): runs the causal
+  full-buffer forward over a bucket-padded prompt buffer, scatters the
+  per-layer K/V into the target slots' cache rows, and samples each row's
+  first token. Under causal attention the buffer width changes cost only,
+  never values, so length-bucketing (scheduler.py) is free correctness-wise.
+* **step** (one variant total): advances ALL slots one token — each row
+  writes its pending token's K/V at its OWN cursor (`_decode_one`'s per-row
+  scatter), attends over its prefix, and samples its next token. Free/dead
+  slots compute garbage that flows only into garbage: their rows are
+  overwritten by the next prefill before anything can attend to them (the
+  same argument as the pipeline bubble steps, models/transformer.py).
+
+Step loop (host): retire -> admit (scheduler FIFO groups -> prefill) ->
+one decode dispatch. TTFT/TPOT/queue-wait are measured per request and
+emitted through obs/ (SpanTracer spans + MetricsWriter events) so a serving
+run renders in the same Chrome trace / summary pipeline as training.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.decode import (_decode_one, _prefill, make_token_sampler,
+                             rope_tables)
+from ..config import resolve_dtype
+from .kv_manager import KVCachePool, POOL_SPEC
+from .scheduler import FIFOScheduler
+
+
+@dataclass
+class Request:
+    """One generation request. `tokens` fills with the generated ids (EOS
+    excluded, like GreedyDecoder.decode); the *_t fields are engine-clock
+    samples for the serving metrics."""
+
+    rid: int
+    prompt: List[int]
+    max_new: int
+    seed: int = 0
+    arrival: float = 0.0                 # loadgen's planned arrival offset
+    tokens: List[int] = field(default_factory=list)
+    submit_t: Optional[float] = None     # entered the admission queue
+    admit_t: Optional[float] = None      # left the queue (prefill dispatch)
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    prompt_len: int = 0
+    limit: int = 0
+
+    # -- derived metrics (seconds; None until the request finishes) ------
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.submit_t is None or self.admit_t is None:
+            return None
+        return self.admit_t - self.submit_t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.submit_t is None or self.first_token_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Time per output token AFTER the first (the decode-loop rate);
+        None with < 2 tokens."""
+        if (self.first_token_t is None or self.finish_t is None
+                or len(self.tokens) < 2):
+            return None
+        return (self.finish_t - self.first_token_t) / (len(self.tokens) - 1)
+
+
+def decode_prompts(engine: "ContinuousBatchingEngine", prompts,
+                   max_new, base_seed: int = 0) -> List[List[int]]:
+    """Batch-CLI convenience shared by generate.py and evaluate.py: submit
+    `prompts` FIFO with per-request seeds base_seed+i, drain the engine,
+    and return the generated ids in PROMPT order. `max_new` is an int
+    (shared budget) or a per-prompt sequence."""
+    budgets = ([max_new] * len(prompts) if isinstance(max_new, int)
+               else list(max_new))
+    for i, pr in enumerate(prompts):
+        engine.submit(Request(rid=i, prompt=pr, max_new=budgets[i],
+                              seed=base_seed + i))
+    engine.run_to_completion()
+    return [r.tokens for r in sorted(engine.completed, key=lambda r: r.rid)]
+
+
+def _pow2_at_most(n: int, cap: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return min(p, cap) if cap else p
+
+
+class ContinuousBatchingEngine:
+    """Slot-based continuous batching over a TP-sharded KV pool.
+
+    Sampling knobs are build-time constants (one compiled step serves every
+    request, like GreedyDecoder); randomness is PER REQUEST via its seed
+    (`make_token_sampler`'s fold-in schedule), so a request's sampled tokens
+    reproduce regardless of arrival order, slot placement, or batch mix.
+    """
+
+    def __init__(self, model, mesh: Mesh, params, num_slots: int,
+                 buf_len: int, eos_id: int, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0,
+                 prefill_bucket: int = 64, max_prefill_batch: int = 4,
+                 max_queue: int = 0, tracer=None, writer=None,
+                 clock=time.monotonic):
+        if getattr(model, "cp_size", 1) > 1:
+            raise ValueError(
+                "the serving engine decodes on the cp=1 path (per-slot "
+                "caches are replicated over cp); long-context cp prefill "
+                "stays with models/decode.GreedyDecoder — rebuild the "
+                f"model with cp_size=1 (got {model.cp_size})")
+        cap = getattr(model, "max_decode_positions", None)
+        if cap is not None and buf_len > cap:
+            raise ValueError(
+                f"buf_len {buf_len} exceeds the model's learned position "
+                f"table ({cap}); clamp the buffer or retrain with a larger "
+                f"maxlen")
+        if max_prefill_batch < 1:
+            raise ValueError(f"max_prefill_batch must be >= 1, got "
+                             f"{max_prefill_batch}")
+        self.model = model
+        self.mesh = mesh
+        self.params = params
+        self.buf_len = buf_len
+        self.eos_id = int(eos_id)
+        self.max_prefill_batch = max_prefill_batch
+        self._clock = clock
+        self.tracer = tracer
+        self.writer = writer
+        self._dtype = resolve_dtype(model.cfg.compute_dtype)
+        self._table_len = max(model.cfg.maxlen, buf_len)
+        self._sample = make_token_sampler(model, temperature=temperature,
+                                          top_k=top_k, top_p=top_p)
+        self.pool = KVCachePool(model, mesh, num_slots, buf_len)
+        self.scheduler = FIFOScheduler(buf_len, prefill_bucket=prefill_bucket,
+                                       max_queue=max_queue, clock=clock)
+        n = num_slots + 1  # + the scratch row (kv_manager.py)
+        self._tokens = np.zeros(n, np.int32)
+        self._pos = np.zeros(n, np.int32)
+        self._seeds = np.zeros(n, np.uint32)
+        self._slot_req: Dict[int, Request] = {}
+        self._step_fn = self._build_step(n)
+        self._prefill_fns: Dict[tuple, object] = {}
+        self.completed: List[Request] = []
+        # -- aggregate stats ---------------------------------------------
+        self.decode_steps = 0
+        self.generated_tokens = 0
+        self._occupancy_sum = 0.0
+        self.prefill_positions = 0            # Σ nb * width dispatched
+        self.prefill_positions_monolithic = 0  # Σ rows * buf_len (no bucket)
+        self.prompt_tokens = 0
+
+    # -- compiled programs ----------------------------------------------
+    def _tables(self):
+        if not self.model.uses_rope:
+            return None, None
+        return rope_tables(self._table_len, self.model.cfg.head_dim,
+                           self.model.cfg.rope_theta)
+
+    def _build_step(self, n: int):
+        model, buf_len, dtype = self.model, self.buf_len, self._dtype
+
+        def shard_fn(params, pool_k, pool_v, tokens, pos, seeds):
+            cos_t, sin_t = self._tables()
+            pool_k, pool_v, logits = _decode_one(
+                model, params, pool_k, pool_v, tokens, pos, buf_len,
+                cos_t, sin_t, dtype)
+            tok = self._sample(logits, seeds, pos + 1)
+            return pool_k, pool_v, tok
+
+        fn = jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None), P(None),
+                      P(None)),
+            out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _build_prefill(self, nb: int, width: int):
+        model, dtype = self.model, self._dtype
+
+        def shard_fn(params, pool_k, pool_v, buf, prompt_len, slots, seeds):
+            cos_t, sin_t = self._tables()
+            ks, vs, logits = _prefill(model, params, buf, prompt_len,
+                                      cos_t, sin_t, dtype)
+            # scatter the (L, nb, kvh, width, hd) prefill caches into the
+            # target slots' first `width` rows; rows past the prompt are
+            # re-written by decode steps before any query attends to them
+            pool_k = pool_k.at[:, slots, :, :width, :].set(
+                ks.astype(pool_k.dtype))
+            pool_v = pool_v.at[:, slots, :, :width, :].set(
+                vs.astype(pool_v.dtype))
+            tok = self._sample(logits, seeds, prompt_len)
+            return pool_k, pool_v, tok
+
+        fn = jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None, None),
+                      P(None), P(None), P(None)),
+            out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    # -- request intake --------------------------------------------------
+    def submit(self, req: Request) -> None:
+        """FIFO enqueue (raises scheduler.QueueFull past the backpressure
+        bound)."""
+        self.scheduler.submit(req)
+
+    def has_work(self) -> bool:
+        return bool(self.scheduler.pending or self._slot_req)
+
+    @property
+    def live_requests(self) -> int:
+        return len(self._slot_req)
+
+    # -- the continuous-batching loop ------------------------------------
+    def step(self) -> List[Request]:
+        """One engine iteration: admit queued prompts into free slots
+        (bucket-grouped prefills), then advance every live slot one token.
+        Returns the requests that finished during this iteration."""
+        done: List[Request] = []
+        self._admit(done)
+        if self._slot_req:
+            self._decode(done)
+        return done
+
+    def run_to_completion(self) -> List[Request]:
+        """Drain the queue and all live slots; returns all completions in
+        finish order."""
+        out: List[Request] = []
+        while self.has_work():
+            out.extend(self.step())
+        return out
+
+    # -- internals --------------------------------------------------------
+    def _span(self, name, **args):
+        if self.tracer is not None:
+            return self.tracer.span(name, cat="serve", **args)
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _admit(self, done: List[Request]) -> None:
+        while self.scheduler.pending and self.pool.free_slots:
+            group = self.scheduler.take_batch(
+                min(self.pool.free_slots, self.max_prefill_batch))
+            if not group:
+                break
+            now = self._clock()
+            ready = []
+            for req in group:
+                req.admit_t = now
+                req.prompt_len = len(req.prompt)
+                req.limit = min(req.prompt_len + req.max_new, self.buf_len)
+                self.prompt_tokens += req.prompt_len
+                if req.limit <= req.prompt_len:   # max_new == 0
+                    req.finish_t = now
+                    self._complete(req, done)
+                else:
+                    ready.append(req)
+            if not ready:
+                continue
+            self._prefill_group(ready, done)
+
+    def _prefill_group(self, ready: List[Request], done: List[Request]):
+        width = self.scheduler.group_width(ready)
+        nb = _pow2_at_most(len(ready), self.max_prefill_batch)
+        slots = self.pool.alloc_many(len(ready))
+        buf = np.full((nb, width), self.eos_id, np.int32)
+        plens = np.ones(nb, np.int32)          # pad rows: 1-token dummy
+        slot_idx = np.full(nb, self.pool.scratch_slot, np.int32)
+        seeds = np.zeros(nb, np.uint32)
+        for i, req in enumerate(ready):
+            buf[i, : req.prompt_len] = req.prompt
+            plens[i] = req.prompt_len
+            slot_idx[i] = slots[i]
+            seeds[i] = np.uint32(req.seed)
+        key = (nb, width)
+        if key not in self._prefill_fns:
+            self._prefill_fns[key] = self._build_prefill(nb, width)
+        with self._span("prefill", rows=len(ready), nb=nb, width=width):
+            ks, vs, tok = self._prefill_fns[key](
+                self.params, self.pool.ks, self.pool.vs, jnp.asarray(buf),
+                jnp.asarray(plens), jnp.asarray(slot_idx),
+                jnp.asarray(seeds))
+            self.pool.adopt(ks, vs)
+            tok = np.asarray(tok)
+        self.prefill_positions += nb * width
+        self.prefill_positions_monolithic += len(ready) * self.buf_len
+        now = self._clock()
+        for i, req in enumerate(ready):
+            req.first_token_t = now
+            first = int(tok[i])
+            if first == self.eos_id:              # 0 generated tokens
+                req.finish_t = now
+                self.pool.free(slots[i])
+                self._complete(req, done)
+                continue
+            slot = slots[i]
+            self._slot_req[slot] = req
+            self._tokens[slot] = first
+            self._pos[slot] = req.prompt_len
+            self._seeds[slot] = np.uint32(req.seed)
+
+    def _decode(self, done: List[Request]) -> None:
+        with self._span("decode_step", live=len(self._slot_req)):
+            ks, vs, tok = self._step_fn(
+                self.params, self.pool.ks, self.pool.vs,
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                jnp.asarray(self._seeds))
+            self.pool.adopt(ks, vs)
+            tok = np.asarray(tok)
+        now = self._clock()
+        self.decode_steps += 1
+        self._occupancy_sum += self.pool.occupancy
+        if self.tracer is not None:
+            self.tracer.counter("slots_live", len(self._slot_req))
+        for slot, req in list(self._slot_req.items()):
+            # the pending token was written at `pos` by this dispatch: it
+            # is now part of the output (mirrors make_generate's buf write)
+            req.tokens.append(int(self._tokens[slot]))
+            self.generated_tokens += 1
+            cand = int(tok[slot])
+            self._pos[slot] += 1
+            gen = len(req.tokens)
+            if cand == self.eos_id or req.prompt_len + gen >= req.limit:
+                req.finish_t = now
+                del self._slot_req[slot]
+                self.pool.free(slot)
+                self._complete(req, done)
+            else:
+                self._tokens[slot] = cand
+
+    def _complete(self, req: Request, done: List[Request]) -> None:
+        self.completed.append(req)
+        done.append(req)
+        if self.writer is not None:
+            ms = lambda s: None if s is None else round(s * 1e3, 3)
+            self.writer.event(
+                "serve_request", rid=req.rid, prompt_len=req.prompt_len,
+                generated=len(req.tokens),
+                queue_wait_ms=ms(req.queue_wait_s), ttft_ms=ms(req.ttft_s),
+                tpot_ms=ms(req.tpot_s))
+
+    # -- aggregate view ---------------------------------------------------
+    def stats(self) -> dict:
+        occ = (self._occupancy_sum / self.decode_steps
+               if self.decode_steps else 0.0)
+        mono = max(self.prefill_positions_monolithic, 1)
+        return {
+            "decode_steps": self.decode_steps,
+            "generated_tokens": self.generated_tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "completed": len(self.completed),
+            "rejected": self.scheduler.rejected,
+            "slot_occupancy_mean": round(occ, 4),
+            "prefill_positions": self.prefill_positions,
+            # share of the monolithic full-buffer prefill cost that
+            # length-bucketing removed (generate.py logs this). Can go
+            # NEGATIVE when bucketing is off but pow2 batch-padding added
+            # rows — callers gate their print on > 0
+            "prefill_pad_waste_eliminated": round(
+                1.0 - self.prefill_positions / mono, 4)
+            if self.prefill_positions_monolithic else 0.0,
+        }

@@ -1,0 +1,104 @@
+"""Slot-granular KV-cache pool for the continuous-batching engine.
+
+The one-shot decoder (`models/decode.make_generate`) materialises fresh
+per-layer K/V tensors inside every generation dispatch — fine when a whole
+prompt batch lives and dies together, fatal for serving, where request i
+retires while request j is mid-generation. Here the caches are a persistent
+POOL: one device array per K/V with a `slots` axis,
+
+    (num_layers, num_slots + 1, local_kv_heads, buf_len, head_dim)
+
+sharded over 'tp' on the heads dim — the SAME head partitioning as training
+and one-shot decode (models/decode.py layout), so the same checkpoint params
+drive it unchanged and the per-slot row layout is byte-compatible with what
+`_prefill`/`_decode_one` produce.
+
+Slot lifecycle: `alloc()` leases a free slot to a request; prefill scatters
+the prompt's K/V into that slot's rows; every decode step advances all slots
+in place; `free()` returns the slot. The LAST slot (index `num_slots`) is a
+scratch row that is never leased — prefill batches padded up to a bucket
+size aim their pad rows at it, so pad work can scatter somewhere harmless
+without ever colliding with a live lease.
+
+The pool arrays are handed to jitted programs with `donate_argnums`, so on
+TPU every prefill/step updates the pool IN PLACE (the engine adopts the
+returned arrays via `adopt()`); a refill never reallocates the pool. On
+backends without donation support (CPU tests) XLA falls back to a copy —
+values identical, just not zero-copy.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import resolve_dtype
+
+# pool layout: (layers, slots, kv_heads, buf, head_dim); 'tp' shards the
+# heads dim, everything else replicated — matches models/decode.py caches
+POOL_SPEC = P(None, None, "tp", None, None)
+
+
+class KVCachePool:
+    """Device-resident K/V pool + host-side slot free-list."""
+
+    def __init__(self, model, mesh: Mesh, num_slots: int, buf_len: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        cfg = model.cfg
+        self.num_slots = num_slots
+        self.buf_len = buf_len
+        self.scratch_slot = num_slots          # never leased; pad-row target
+        self.dtype = resolve_dtype(cfg.compute_dtype)
+        shape = (cfg.num_layers, num_slots + 1, cfg.kv_heads, buf_len,
+                 cfg.head_dim)
+        sharding = NamedSharding(mesh, POOL_SPEC)
+        alloc = jax.jit(lambda: jnp.zeros(shape, self.dtype),
+                        out_shardings=sharding)
+        self.ks = alloc()
+        self.vs = alloc()
+        self._free = deque(range(num_slots))
+
+    # -- slot leasing ----------------------------------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return self.live_slots / self.num_slots
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError("KV pool exhausted: no free slot (the "
+                               "scheduler must gate admissions on "
+                               "free_slots)")
+        return self._free.popleft()
+
+    def alloc_many(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(f"KV pool exhausted: asked for {n} slots, "
+                               f"{len(self._free)} free")
+        return [self._free.popleft() for _ in range(n)]
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.num_slots})")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        self._free.append(slot)
+
+    # -- device-array handoff -------------------------------------------
+    def adopt(self, ks, vs) -> None:
+        """Swap in the pool arrays a donating jitted program returned (the
+        old handles were consumed by donation — holding on to them would
+        raise on next use)."""
+        self.ks, self.vs = ks, vs

@@ -1,0 +1,114 @@
+"""FIFO admission queue with length-bucketed prefill batching.
+
+Admission policy: strictly first-come-first-served — `take_batch` peels
+requests off the HEAD of the queue and stops at the first one whose
+bucket-padded prefill width differs from the head's (or when the slot /
+batch budget runs out). Nothing ever jumps the queue, so a stream of
+mixed-length prompts admits in arrival order; the bucketing only decides
+how many neighbours ride the same prefill dispatch.
+
+Width bucketing (the PR-3 `--seq_bucket` idea applied to prefill): a
+prompt of length p prefills over a buffer of width
+`ceil(p / prefill_bucket) * prefill_bucket` (clamped to the pool's
+buf_len) instead of the full decode buffer. Under causal attention the
+K/V rows and the last-position logits for positions < p are bit-identical
+whatever the buffer width, so bucketing changes COST ONLY — the engine's
+token-identity contract (tests/test_serving.py) is width-independent.
+
+Backpressure: `max_queue` bounds the number of waiting requests;
+`submit()` past the bound raises `QueueFull` — the caller (loadgen, a
+future RPC front-end) decides whether that is a drop, a retry, or a
+client-visible 429. Unbounded (0) is the bring-up default.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import TYPE_CHECKING, List, Optional
+
+if TYPE_CHECKING:  # engine imports the scheduler; keep the cycle type-only
+    from .engine import Request
+
+
+class QueueFull(RuntimeError):
+    """Raised by submit() when the admission queue is at max_queue."""
+
+
+def bucket_width(prompt_len: int, prefill_bucket: int, buf_len: int) -> int:
+    """Bucket-padded prefill width for a prompt: smallest multiple of
+    `prefill_bucket` >= prompt_len, clamped to buf_len (prefill never needs
+    more than the decode buffer). `prefill_bucket` 0 disables bucketing
+    (every prefill uses the full buffer, the one-shot decoder's padding
+    behaviour)."""
+    if prefill_bucket <= 0:
+        return buf_len
+    w = -(-prompt_len // prefill_bucket) * prefill_bucket
+    return min(w, buf_len)
+
+
+class FIFOScheduler:
+    def __init__(self, buf_len: int, prefill_bucket: int = 64,
+                 max_queue: int = 0,
+                 clock=time.monotonic):
+        self.buf_len = buf_len
+        self.prefill_bucket = prefill_bucket
+        self.max_queue = max_queue
+        self._clock = clock
+        self._queue: "deque[Request]" = deque()  # noqa: F821 — type-only
+        self.rejected = 0
+
+    def __len__(self) -> int:
+        return len(self._queue)
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def submit(self, req: Request) -> None:
+        """Enqueue a request (FIFO). Raises QueueFull past `max_queue`;
+        validates the prompt fits the decode buffer NOW, not at admission
+        time (a doomed request must not wait in line to fail)."""
+        if not req.prompt:
+            raise ValueError(f"request {req.rid}: prompt must be non-empty "
+                             f"(a width-0 prefill has no position to sample "
+                             f"the first token from)")
+        if len(req.prompt) >= self.buf_len:
+            raise ValueError(
+                f"request {req.rid}: prompt length {len(req.prompt)} must "
+                f"leave room in buf_len {self.buf_len}")
+        if req.max_new < 0:
+            raise ValueError(f"request {req.rid}: max_new must be >= 0, "
+                             f"got {req.max_new}")
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            self.rejected += 1
+            raise QueueFull(
+                f"admission queue full ({self.max_queue} waiting); request "
+                f"{req.rid} refused — retry later or raise --queue_limit")
+        if req.submit_t is None:
+            req.submit_t = self._clock()
+        self._queue.append(req)
+
+    def take_batch(self, max_requests: int) -> List[Request]:
+        """Pop the next prefill group: up to `max_requests` requests from
+        the queue HEAD that share the head's bucket-padded width. Returns
+        [] when the queue is empty or max_requests == 0. Strict FIFO: the
+        group is always a PREFIX of the queue."""
+        if not self._queue or max_requests <= 0:
+            return []
+        head_w = bucket_width(len(self._queue[0].prompt),
+                              self.prefill_bucket, self.buf_len)
+        group: List[Request] = []
+        while (self._queue and len(group) < max_requests
+               and bucket_width(len(self._queue[0].prompt),
+                                self.prefill_bucket,
+                                self.buf_len) == head_w):
+            group.append(self._queue.popleft())
+        return group
+
+    def group_width(self, group: List[Request]) -> int:
+        return bucket_width(max(len(r.prompt) for r in group),
+                            self.prefill_bucket, self.buf_len)
+
+    def peek_submit_t(self) -> Optional[float]:
+        return self._queue[0].submit_t if self._queue else None

@@ -1,0 +1,236 @@
+"""Offline serving benchmark CLI: loadgen -> continuous-batching engine.
+
+`python -m distributed_pytorch_from_scratch_tpu.serving.serve \
+     --ckpt_dir ... --tokenizer_path ... --rate 4 --num_requests 64`
+
+Drives the continuous-batching engine (serving/engine.py) with a synthetic
+Poisson/burst arrival stream (or a replayed trace) and reports the serving
+metrics — TTFT / TPOT / queue-wait p50/p95, slot occupancy, tokens/s — as:
+
+* ONE machine-readable JSON line on stdout (the bench.py convention),
+* `serving_summary` + per-request `serve_request` MetricsWriter events and
+  Chrome-trace spans (prefill / decode_step per dispatch) under --log_dir,
+  so `scripts/summarize_run.py` and the Perfetto timeline render a serving
+  run exactly like a training run.
+
+`--random_init` serves fresh random weights at the flag shape (throughput
+and latency depend on shapes, not values — checkpoint-free benchmarking,
+the bench.py --decode convention). `--dry_run` shrinks everything to a
+tiny CPU-runnable smoke (tier-1 coverage: the CLI surface cannot rot on
+images without chips).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..cli import add_model_shape_args, build_model_config
+from ..config import BOS_TOKEN, EOS_TOKEN, MeshConfig, ModelConfig
+from ..runtime.mesh import make_mesh
+
+_DRY_CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=2,
+                       vocab_size=64, maxlen=64)
+
+
+def get_serve_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__)
+    g = p.add_argument_group("model")
+    g.add_argument("--ckpt_dir", default=None,
+                   help="serve this checkpoint (validated complete before "
+                        "assembly); omit with --random_init/--dry_run")
+    g.add_argument("--iter", type=int, default=None,
+                   help="checkpoint iteration (default: latest)")
+    g.add_argument("--random_init", action="store_true",
+                   help="serve fresh random weights at the flag shape "
+                        "(checkpoint-free load benchmarking)")
+    g.add_argument("--tokenizer_path", "-t", default=None,
+                   help="supplies vocab_size and the real EOS id; omit to "
+                        "use --vocab_size and EOS id 1 (the shipped "
+                        "tokenizer's convention)")
+    g.add_argument("--vocab_size", type=int, default=1024,
+                   help="vocab for --random_init without a tokenizer")
+    g.add_argument("--family", choices=["llama", "gpt2"], default="llama")
+    g.add_argument("--tp_size", type=int, default=1)
+    add_model_shape_args(g)
+
+    g = p.add_argument_group("engine")
+    g.add_argument("--slots", type=int, default=8,
+                   help="KV-pool slots = max concurrently decoding requests")
+    g.add_argument("--buf_len", type=int, default=0,
+                   help="per-slot cache length (0 = longest prompt + "
+                        "--max_new_tokens + 2)")
+    g.add_argument("--max_new_tokens", type=int, default=64)
+    g.add_argument("--prefill_bucket", type=int, default=64,
+                   help="prefill width bucket (prompts pad to a multiple "
+                        "of this, not to the full buffer); 0 = off")
+    g.add_argument("--max_prefill_batch", type=int, default=4,
+                   help="max prompts per prefill dispatch (same-bucket "
+                        "FIFO neighbours ride together)")
+    g.add_argument("--queue_limit", type=int, default=0,
+                   help="backpressure: max waiting requests (arrivals past "
+                        "it are rejected and counted); 0 = unbounded")
+    g.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; > 0 samples with per-request seeds")
+    g.add_argument("--decode_top_k", type=int, default=0)
+    g.add_argument("--decode_top_p", type=float, default=0.0)
+
+    g = p.add_argument_group("loadgen")
+    g.add_argument("--num_requests", type=int, default=32)
+    g.add_argument("--rate", type=float, default=4.0,
+                   help="poisson arrival rate, requests/second")
+    g.add_argument("--arrival", choices=["poisson", "burst", "replay"],
+                   default="poisson")
+    g.add_argument("--replay", default=None,
+                   help="jsonl trace for --arrival replay (loadgen.py "
+                        "schema)")
+    g.add_argument("--prompt_len_min", type=int, default=8)
+    g.add_argument("--prompt_len_max", type=int, default=64)
+    g.add_argument("--seed", type=int, default=0)
+
+    g = p.add_argument_group("other")
+    g.add_argument("--log_dir", default="serve_logs",
+                   help="obs output: trace.jsonl/trace.json spans + "
+                        "metrics.jsonl events")
+    g.add_argument("--dry_run", action="store_true",
+                   help="tiny random-init model + a 6-request burst on CPU "
+                        "— the tier-1 smoke; ignores --ckpt_dir")
+    args = p.parse_args(argv)
+    if (args.decode_top_k or args.decode_top_p) and not args.temperature:
+        p.error("--decode_top_k/--decode_top_p need --temperature > 0")
+    if args.arrival == "replay" and not args.replay and not args.dry_run:
+        p.error("--arrival replay needs --replay PATH")
+    if not args.dry_run and not args.random_init and not args.ckpt_dir:
+        p.error("pick a weight source: --ckpt_dir, --random_init, or "
+                "--dry_run")
+    return args
+
+
+def _load_params(args, model, mesh):
+    import jax
+
+    if args.random_init or args.dry_run or not args.ckpt_dir:
+        return jax.device_put(model.init(jax.random.key(args.seed)),
+                              model.shardings(mesh))
+    from ..training.checkpoint import latest_step, load_checkpoint
+    step = args.iter if args.iter is not None else latest_step(args.ckpt_dir)
+    if step is None:
+        raise SystemExit(f"no checkpoints found in {args.ckpt_dir}")
+    # load_checkpoint refuses an incomplete shard set up front with the
+    # missing-rank list (training/checkpoint.validate_checkpoint) — no
+    # KeyError mid-assemble, no separate pre-check needed
+    template = jax.eval_shape(lambda: model.init(jax.random.key(0)))
+    params, _, _ = load_checkpoint(args.ckpt_dir, step, template,
+                                   model.specs())
+    print(f"serving checkpoint iter {step} from {args.ckpt_dir}",
+          file=sys.stderr)
+    return jax.device_put(params, model.shardings(mesh))
+
+
+def serve(args: argparse.Namespace) -> dict:
+    from ..obs import SpanTracer
+    from ..training.metrics import MetricsWriter
+    from .engine import ContinuousBatchingEngine
+    from .loadgen import replay_requests, run_loadgen, synthetic_requests
+
+    eos_id = 1  # the shipped tokenizer's EOS (tokenizer/tokenizer.json)
+    vocab_size = args.vocab_size
+    if args.tokenizer_path:
+        from tokenizers import Tokenizer as HFTokenizer
+        tok = HFTokenizer.from_file(args.tokenizer_path)
+        vocab_size = tok.get_vocab_size()
+        eos_id = tok.token_to_id(EOS_TOKEN)
+        if eos_id is None or tok.token_to_id(BOS_TOKEN) is None:
+            raise SystemExit(f"tokenizer {args.tokenizer_path} lacks the "
+                             f"{BOS_TOKEN}/{EOS_TOKEN} specials")
+
+    if args.dry_run:
+        cfg = _DRY_CFG
+        vocab_size = cfg.vocab_size
+        args.slots, args.max_prefill_batch = 4, 2
+        args.num_requests, args.arrival = 6, "burst"
+        args.prompt_len_min, args.prompt_len_max = 4, 12
+        args.max_new_tokens = min(args.max_new_tokens, 8)
+        args.buf_len, args.prefill_bucket = 24, 8
+    else:
+        cfg = build_model_config(args, vocab_size)
+
+    mesh = make_mesh(MeshConfig(tp=args.tp_size))
+    if args.family == "gpt2":
+        from ..models.gpt2 import GPT2Transformer
+        model = GPT2Transformer(cfg, tp_size=args.tp_size)
+    else:
+        from ..models.transformer import Transformer
+        model = Transformer(cfg, tp_size=args.tp_size)
+    params = _load_params(args, model, mesh)
+
+    if args.arrival == "replay" and args.replay:
+        requests = replay_requests(args.replay)
+    else:
+        requests = synthetic_requests(
+            args.num_requests, args.prompt_len_min, args.prompt_len_max,
+            args.max_new_tokens, vocab_size, seed=args.seed,
+            rate=args.rate, arrival=args.arrival)
+    longest = max(len(r.prompt) for r in requests)
+    buf_len = args.buf_len or (longest + args.max_new_tokens + 2)
+    cap = getattr(model, "max_decode_positions", None)
+    if cap is not None and buf_len > cap:
+        if cap < longest + 2:
+            raise SystemExit(f"prompts need {longest + 2} positions but the "
+                             f"model's position table has {cap}")
+        print(f"Warning: clamping serve buffer {buf_len} -> {cap} (learned "
+              f"position table size)", file=sys.stderr)
+        buf_len = cap
+
+    tracer = SpanTracer(args.log_dir, process_name="serve")
+    writer = MetricsWriter(args.log_dir, process_index=0)
+    try:
+        engine = ContinuousBatchingEngine(
+            model, mesh, params, num_slots=args.slots, buf_len=buf_len,
+            eos_id=eos_id, temperature=args.temperature,
+            top_k=args.decode_top_k, top_p=args.decode_top_p,
+            prefill_bucket=args.prefill_bucket,
+            max_prefill_batch=args.max_prefill_batch,
+            max_queue=args.queue_limit, tracer=tracer, writer=writer)
+        summary = run_loadgen(engine, requests)
+    finally:
+        path = tracer.close()
+        writer.close()
+    fmt = lambda v: "-" if v is None else f"{v:.1f}"
+    print(f"serve[{args.family} tp{args.tp_size}]: {summary['completed']}/"
+          f"{summary['requests']} requests ({summary['rejected']} rejected) "
+          f"in {summary['wall_s']:.1f}s — "
+          f"{summary['tokens_per_sec']:.0f} tok/s, occupancy "
+          f"{summary['slot_occupancy_mean']:.2f}, TTFT p50/p95 "
+          f"{fmt(summary['ttft_ms_p50'])}/{fmt(summary['ttft_ms_p95'])}ms, "
+          f"TPOT p50/p95 {fmt(summary['tpot_ms_p50'])}/"
+          f"{fmt(summary['tpot_ms_p95'])}ms, queue p50/p95 "
+          f"{fmt(summary['queue_wait_ms_p50'])}/"
+          f"{fmt(summary['queue_wait_ms_p95'])}ms"
+          + (f"; pad waste eliminated "
+             f"{100 * summary['prefill_pad_waste_eliminated']:.0f}%"
+             if summary["prefill_pad_waste_eliminated"] > 0 else "")
+          + (f"; trace {path}" if path else ""), file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"serving tokens/sec ({args.family}, tp={args.tp_size}, "
+                   f"slots={args.slots}, {args.arrival} arrivals"
+                   + (f" @{args.rate:g}/s" if args.arrival == "poisson"
+                      else "") + ")"),
+        "value": summary["tokens_per_sec"],
+        "unit": "tokens/sec (serving)",
+        **{k: summary[k] for k in (
+            "requests", "completed", "rejected", "invalid", "wall_s",
+            "slot_occupancy_mean", "ttft_ms_p50", "ttft_ms_p95",
+            "tpot_ms_p50", "tpot_ms_p95", "queue_wait_ms_p50",
+            "queue_wait_ms_p95", "prefill_pad_waste_eliminated")},
+    }))
+    return summary
+
+
+def main(argv=None) -> dict:
+    return serve(get_serve_args(argv))
+
+
+if __name__ == "__main__":
+    main()

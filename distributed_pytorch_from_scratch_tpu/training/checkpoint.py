@@ -227,22 +227,50 @@ def find_rank_shards(ckpt_dir: str, step: int, ext: str = "npz"
     return rank_files
 
 
+def validate_checkpoint(ckpt_dir: str, step: int, ext: str = "npz"
+                        ) -> Tuple[int, Dict[int, str]]:
+    """Refuse an incomplete shard set EARLY, before any assembly work.
+
+    Returns (tp_size, {rank: path}) when every rank shard of iteration
+    `step` is present. Raises FileNotFoundError naming the missing rank
+    list otherwise — a partial copy (one rank file lost in transfer) used
+    to surface as a cryptic KeyError mid-assemble in `find_rank_shards`
+    consumers; the serving loader (serving/serve.py), `load_checkpoint`,
+    and the torch-checkpoint interop all validate through here now.
+
+    The expected rank count comes from the `__tp_size__` metadata any one
+    npz shard carries; formats without it (ext='pth') fall back to
+    max(rank)+1, which still catches every hole below the highest
+    surviving rank."""
+    rank_files = find_rank_shards(ckpt_dir, step, ext=ext)
+    if not rank_files:
+        raise FileNotFoundError(f"no checkpoint for iter {step} in "
+                                f"{ckpt_dir}")
+    tp_size = None
+    if ext == "npz":
+        any_rank = next(iter(rank_files))
+        try:
+            tp_size = int(np.load(rank_files[any_rank])["__tp_size__"])
+        except KeyError:  # pre-metadata file: fall back to the rank span
+            tp_size = None
+    if tp_size is None:
+        tp_size = max(rank_files) + 1
+    missing = sorted(set(range(tp_size)) - set(rank_files))
+    if missing:
+        raise FileNotFoundError(
+            f"checkpoint iter {step} was written with tp_size={tp_size} but "
+            f"shard files for rank(s) {missing} are missing from {ckpt_dir} "
+            f"— restore the missing rank file(s) or re-save the checkpoint")
+    return tp_size, rank_files
+
+
 def load_checkpoint(save_dir: str, step: int, params_template: Any,
                     specs: Any, with_opt: bool = False):
     """Reassemble global arrays from all per-rank shards of iteration `step`.
 
     Returns (params, opt_state | None, step).
     """
-    rank_files = find_rank_shards(save_dir, step)
-    if not rank_files:
-        raise FileNotFoundError(f"no checkpoint for iter {step} in {save_dir}")
-    any_rank = next(iter(rank_files))
-    tp_size = int(np.load(rank_files[any_rank])["__tp_size__"])
-    missing = sorted(set(range(tp_size)) - set(rank_files))
-    if missing:
-        raise FileNotFoundError(
-            f"checkpoint iter {step} was written with tp_size={tp_size} but "
-            f"shard files for rank(s) {missing} are missing from {save_dir}")
+    tp_size, rank_files = validate_checkpoint(save_dir, step)
     shards = {r: dict(np.load(rank_files[r])) for r in range(tp_size)}
 
     flat_specs = _flatten(specs, "param")

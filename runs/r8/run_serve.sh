@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-8 serving session (ISSUE 5): continuous-batching engine under
+# load on the 45m shape. Order: a loadgen sweep (poisson arrivals at two
+# rates, then a backpressured burst — each run writes its own obs dir so
+# the Chrome traces and serving_summary events stay separable), then the
+# serving-vs-one-shot bench line, then the run summary.
+# Weights are random inits (--random_init): serving latency/throughput
+# depend on shapes, not values, so no checkpoint transfer burns window.
+# Idempotent; reuses the round-5 session helpers (step/bench_line
+# artifact guards, SESSION_DEADLINE chokepoint via scripts/run_step.py).
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r8
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r8 serving pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 1. loadgen sweep: open-loop poisson at a light and a saturating rate
+#    (same request distribution, so the TTFT/queue-wait deltas isolate
+#    queueing), tp over all local chips via the engine's tp-sharded pool
+step serve_rate2 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --slots 8 --num_requests 64 --rate 2 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --prefill_bucket 128 --log_dir runs/r8/serve_rate2
+step serve_rate8 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --slots 8 --num_requests 64 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --prefill_bucket 128 --log_dir runs/r8/serve_rate8
+
+# 2. closed-loop burst with a backpressure bound: worst-case queue depth,
+#    rejected-request accounting exercised for real
+step serve_burst 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --slots 8 --num_requests 96 --arrival burst --queue_limit 48 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --prefill_bucket 128 --log_dir runs/r8/serve_burst
+
+# 3. the headline A/B: continuous batching vs one-shot GreedyDecoder
+#    batches of the same request set (vs_baseline = the speedup)
+bench_line 45mserving 1200 --serving --model 45m --tp 1 --slots 8 --serve_requests 32 --prompt_len 128 --gen_tokens 128
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r8 serving done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

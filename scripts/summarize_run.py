@@ -31,6 +31,14 @@ def bench_lines(rdir):
             mfu = rec.get("vs_baseline", 0) * 0.30 * 100
             rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
                         f"| {mfu:.1f}% | {rec.get('metric')} |")
+        elif rec.get("unit") == "tokens/sec (serving)":
+            occ = rec.get("slot_occupancy_mean")
+            detail = (f"TTFT p50/p95 {rec.get('ttft_ms_p50')}/"
+                      f"{rec.get('ttft_ms_p95')}ms"
+                      + (f", occupancy {occ}" if occ is not None else ""))
+            rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
+                        f"| x{rec.get('vs_baseline')} vs one-shot decode "
+                        f"| {detail} |")
         elif rec.get("unit") == "ms/step":  # --breakdown accounting line
             comp = rec.get("components", {})
             detail = ", ".join(f"{k}={v}" for k, v in comp.items())
@@ -119,6 +127,40 @@ def obs_lines(rdir):
     return rows_g, rows_h
 
 
+def serving_lines(rdir):
+    """`serving_summary` events (serving/loadgen.py) from every
+    metrics*.jsonl under the runs dir — the continuous-batching runs'
+    TTFT/TPOT/queue percentiles, occupancy and throughput."""
+    rows = []
+
+    def ms(rec, key):
+        v = rec.get(key)
+        return "-" if v is None else f"{v:.0f}"
+
+    for p in sorted(glob.glob(os.path.join(rdir, "**", "metrics*.jsonl"),
+                              recursive=True)):
+        rel = os.path.relpath(p, rdir)
+        for line in open(p, errors="replace"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("tag") != "serving_summary":
+                continue
+            rows.append(
+                f"- `{rel}`: {rec.get('completed')}/{rec.get('requests')} "
+                f"requests ({rec.get('rejected', 0)} rejected) in "
+                f"{rec.get('wall_s', 0):.1f}s — "
+                f"{rec.get('tokens_per_sec', 0)} tok/s, occupancy "
+                f"{rec.get('slot_occupancy_mean', 0)}, TTFT p50/p95 "
+                f"{ms(rec, 'ttft_ms_p50')}/{ms(rec, 'ttft_ms_p95')}ms, "
+                f"TPOT p50/p95 {ms(rec, 'tpot_ms_p50')}/"
+                f"{ms(rec, 'tpot_ms_p95')}ms, queue p50/p95 "
+                f"{ms(rec, 'queue_wait_ms_p50')}/"
+                f"{ms(rec, 'queue_wait_ms_p95')}ms")
+    return rows
+
+
 def manifest_failures(rdir):
     """Steps that failed, from the run_step manifest — forensics inline."""
     path = os.path.join(rdir, "session_manifest.jsonl")
@@ -163,6 +205,11 @@ def summarize(rdir):
         out.append("")
         out.append("Training-health events (sentinel/watchdog):")
         out.extend(health)
+    serving = serving_lines(rdir)
+    if serving:
+        out.append("")
+        out.append("Serving (continuous batching, serving/):")
+        out.extend(serving)
     vals, decodes = eval_summary(rdir)
     if vals:
         out.append("")

@@ -68,6 +68,13 @@ CORE_LANE = {
     "test_kv_decode.py": ["test_kv_matches_nocache[0-prompt0-1]",
                           "TestContextParallelDecode::"
                           "test_cp_decode_matches_cp1[2-1]"],
+    # serving: the continuous-batching token-identity anchor (tp=2 covers
+    # the tp=1 lowering modulo collectives), the pure-host scheduler
+    # properties, and the serve CLI smoke (the chip-less-image rot guard)
+    "test_serving.py": ["test_engine_matches_greedy_decoder[2]",
+                        "test_scheduler_fifo_bucket_groups",
+                        "test_scheduler_backpressure_and_validation",
+                        "test_serve_dry_run_smoke"],
     "test_sequence_parallel.py": ["test_model_sp_matches_vanilla[1-1-4]"],
     "test_overlap.py": ["test_ag_matmul_matches_gather_dot_oracle[1-2]",
                         "test_matmul_rs_matches_dot_scatter_oracle[2]",

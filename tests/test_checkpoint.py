@@ -17,7 +17,8 @@ from distributed_pytorch_from_scratch_tpu.config import MeshConfig, ModelConfig
 from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
 from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
 from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
-    latest_step, list_checkpoints, load_checkpoint, save_checkpoint)
+    latest_step, list_checkpoints, load_checkpoint, save_checkpoint,
+    validate_checkpoint)
 from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
 
 CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
@@ -93,6 +94,41 @@ def test_async_save_matches_sync_and_survives_donation(tmp_path):
         assert sorted(a.files) == sorted(s.files)
         for key in a.files:
             np.testing.assert_array_equal(a[key], s[key])
+
+
+def test_missing_rank_shard_refused_early(tmp_path):
+    """An incomplete shard set (one rank file lost in transfer) must fail
+    BEFORE assembly with the missing-rank list — it used to surface as a
+    cryptic KeyError mid-assemble in find_rank_shards consumers. The
+    serving loader (serving/serve.py) and interop validate through the
+    same `validate_checkpoint`."""
+    import pytest
+
+    model = Transformer(CFG, tp_size=4)
+    params = model.init(jax.random.key(6))
+    save_checkpoint(str(tmp_path), 9, 1.0, params, model.specs(), tp_size=4)
+
+    # a complete set validates and reports its tp_size
+    tp_size, rank_files = validate_checkpoint(str(tmp_path), 9)
+    assert tp_size == 4 and sorted(rank_files) == [0, 1, 2, 3]
+
+    os.remove(os.path.join(tmp_path, "tprank-2_iter-9_loss-1.0000.npz"))
+    with pytest.raises(FileNotFoundError, match=r"rank\(s\) \[2\]"):
+        validate_checkpoint(str(tmp_path), 9)
+    with pytest.raises(FileNotFoundError, match=r"rank\(s\) \[2\]"):
+        load_checkpoint(str(tmp_path), 9, params, model.specs())
+
+    # rank 0 missing too: the metadata is read from ANY surviving shard
+    os.remove(os.path.join(tmp_path, "tprank-0_iter-9_loss-1.0000.npz"))
+    with pytest.raises(FileNotFoundError, match=r"rank\(s\) \[0, 2\]"):
+        validate_checkpoint(str(tmp_path), 9)
+
+    # pth (interop) fallback: no metadata, rank span catches the hole
+    for r in (0, 1, 3):
+        open(os.path.join(tmp_path, f"tprank-{r}_iter-3_loss-1.0.pth"),
+             "wb").close()
+    with pytest.raises(FileNotFoundError, match=r"rank\(s\) \[2\]"):
+        validate_checkpoint(str(tmp_path), 3, ext="pth")
 
 
 def test_retention_pruning(tmp_path):

@@ -269,9 +269,12 @@ def test_moe_decode_matches_forward():
                            (2, 1))
             logits = fwd(params, ids, pos)
             nxt = int(jnp.argmax(logits[0, -1, :cfg.vocab_size]))
-            seq.append(nxt)
+            # the decoder's contract excludes EOS from the returned ids
+            # (models/decode.decode_batch) — the oracle must too, or an
+            # early-EOS init makes the lists differ by the terminator
             if nxt == eos:
                 break
+            seq.append(nxt)
         assert out == seq[len(p):], (out, seq[len(p):])
 
 

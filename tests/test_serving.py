@@ -1,0 +1,266 @@
+"""Continuous-batching serving engine (serving/) correctness.
+
+The anchor contract: continuous-batched GREEDY decode is token-identical
+to per-prompt `models/decode.GreedyDecoder` output — for every request,
+across arrival orders, slot reuse, prefill length-bucketing, and tp
+sharding. Both drivers share one lowering (`_prefill` / `_decode_one` /
+the sampler filters), and each row's math is row-independent, so the
+equality is exact, not approximate.
+
+Plus: slot refill must not leak the prior occupant's cache rows, sampled
+decoding must reproduce per request seed regardless of batch mix, the
+FIFO scheduler's bucket grouping and backpressure bound, and the serve.py
+--dry_run CPU smoke (the CLI surface cannot rot on chip-less images).
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_pytorch_from_scratch_tpu.config import MeshConfig, ModelConfig
+from distributed_pytorch_from_scratch_tpu.models.decode import GreedyDecoder
+from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
+from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+from distributed_pytorch_from_scratch_tpu.serving.engine import (
+    ContinuousBatchingEngine, Request)
+from distributed_pytorch_from_scratch_tpu.serving.scheduler import (
+    FIFOScheduler, QueueFull, bucket_width)
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                  vocab_size=96, maxlen=64)
+BUF = 32
+EOS = 1
+
+PROMPTS = [
+    [0, 5, 17, 33, 60],
+    [0, 95],                        # boundary vocab id
+    [0, 2, 4, 6, 8, 10, 12, 14],    # longer prompt (different bucket)
+    [0, 7],
+    [0, 9, 11],
+    [0, 3, 5, 7, 11, 13, 17],
+]
+
+
+def _setup(tp, seed=7):
+    mesh = make_mesh(MeshConfig(dp=1, tp=tp))
+    model = Transformer(CFG, tp_size=tp)
+    params = jax.device_put(model.init(jax.random.key(seed)),
+                            model.shardings(mesh))
+    return mesh, model, params
+
+
+@pytest.mark.parametrize("tp", [1, 2])
+def test_engine_matches_greedy_decoder(tp):
+    """Staggered admissions + forced slot reuse (6 requests through 2
+    slots), submissions in a shuffled order mid-flight: every request's
+    greedy tokens equal its solo GreedyDecoder decode."""
+    mesh, model, params = _setup(tp)
+    dec = GreedyDecoder(model, mesh, BUF)
+    refs = [dec.decode(params, p, EOS, max_total_len=len(p) + 10)
+            for p in PROMPTS]
+    eng = ContinuousBatchingEngine(model, mesh, params, num_slots=2,
+                                   buf_len=BUF, eos_id=EOS,
+                                   prefill_bucket=8, max_prefill_batch=2)
+    reqs = [Request(rid=i, prompt=p, max_new=10)
+            for i, p in enumerate(PROMPTS)]
+    eng.submit(reqs[0])
+    eng.submit(reqs[1])
+    for _ in range(3):              # let the first two run a few tokens
+        eng.step()
+    for r in reversed(reqs[2:]):    # late arrivals, reversed order
+        eng.submit(r)
+    eng.run_to_completion()
+    got = {r.rid: r.tokens for r in eng.completed}
+    assert len(got) == len(PROMPTS)
+    for i, ref in enumerate(refs):
+        assert got[i] == ref, (tp, i, got[i], ref)
+    # 6 requests through 2 slots: slots were reused, not just filled once
+    assert eng.stats()["completed"] == 6
+
+
+def test_slot_refill_does_not_leak_prior_occupant():
+    """A refilled slot must behave exactly like a fresh one: decode a
+    long-prompt request through a 1-slot engine (filling many cache rows),
+    then a short-prompt request into the SAME slot — its tokens must equal
+    a fresh engine's (and GreedyDecoder's) output. A leak of the prior
+    occupant's K/V rows would perturb the attention sums."""
+    mesh, model, params = _setup(2, seed=3)
+    long_req = [0] + list(range(3, 25))      # fills rows 0..22+
+    short = [0, 5, 9]
+    ref = GreedyDecoder(model, mesh, BUF).decode(
+        params, short, EOS, max_total_len=len(short) + 8)
+
+    eng = ContinuousBatchingEngine(model, mesh, params, num_slots=1,
+                                   buf_len=BUF, eos_id=EOS,
+                                   prefill_bucket=8)
+    eng.submit(Request(rid=0, prompt=long_req, max_new=6))
+    eng.run_to_completion()
+    eng.submit(Request(rid=1, prompt=short, max_new=8))
+    eng.run_to_completion()
+    got = {r.rid: r.tokens for r in eng.completed}
+    assert got[1] == ref, (got[1], ref)
+
+
+def test_engine_matches_greedy_decoder_gpt2():
+    """The second model family (learned positions, LayerNorm, gelu, tied
+    head) through the same engine programs."""
+    from distributed_pytorch_from_scratch_tpu.models.gpt2 import (
+        GPT2Transformer)
+    cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=2,
+                      vocab_size=96, maxlen=64)
+    mesh = make_mesh(MeshConfig(dp=1, tp=2))
+    model = GPT2Transformer(cfg, tp_size=2)
+    params = jax.device_put(model.init(jax.random.key(9)),
+                            model.shardings(mesh))
+    prompts = [[0, 4, 8, 15], [0, 16, 23, 42, 7, 3]]
+    refs = [GreedyDecoder(model, mesh, BUF).decode(
+        params, p, EOS, max_total_len=len(p) + 8) for p in prompts]
+    eng = ContinuousBatchingEngine(model, mesh, params, num_slots=2,
+                                   buf_len=BUF, eos_id=EOS, prefill_bucket=8)
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=i, prompt=p, max_new=8))
+    eng.run_to_completion()
+    got = {r.rid: r.tokens for r in eng.completed}
+    for i, ref in enumerate(refs):
+        assert got[i] == ref, (i, got[i], ref)
+
+
+def test_sampling_reproducible_per_request_seed():
+    """A sampled request's tokens are a pure function of ITS seed (and the
+    engine's sampling knobs) — independent of arrival order, slot
+    placement, and what shares the batch."""
+    mesh, model, params = _setup(2, seed=0)
+    kw = dict(num_slots=2, buf_len=BUF, eos_id=EOS, prefill_bucket=8,
+              temperature=1.0, top_k=8)
+
+    solo = ContinuousBatchingEngine(model, mesh, params, **kw)
+    solo.submit(Request(rid=0, prompt=[0, 5, 17], max_new=10, seed=11))
+    solo.run_to_completion()
+    solo_tokens = solo.completed[0].tokens
+
+    # same request, different batch mix and arrival position
+    crowd = ContinuousBatchingEngine(model, mesh, params, **kw)
+    crowd.submit(Request(rid=90, prompt=[0, 9, 11, 13], max_new=6, seed=4))
+    crowd.step()
+    crowd.submit(Request(rid=91, prompt=[0, 2], max_new=6, seed=5))
+    crowd.submit(Request(rid=0, prompt=[0, 5, 17], max_new=10, seed=11))
+    crowd.run_to_completion()
+    crowd_tokens = {r.rid: r.tokens for r in crowd.completed}[0]
+    assert crowd_tokens == solo_tokens
+
+    # a different seed should (overwhelmingly) diverge
+    other = ContinuousBatchingEngine(model, mesh, params, **kw)
+    other.submit(Request(rid=0, prompt=[0, 5, 17], max_new=10, seed=12))
+    other.run_to_completion()
+    assert (other.completed[0].tokens != solo_tokens
+            or len(solo_tokens) <= 2)
+    # all draws stay in the real vocab (padded columns masked)
+    assert all(0 <= t < CFG.vocab_size for t in solo_tokens)
+
+
+def test_max_new_budgets():
+    """max_new is a per-request budget: 0 completes instantly with no
+    tokens (and no slot), n caps the generation exactly like
+    GreedyDecoder's total-length limit."""
+    mesh, model, params = _setup(1, seed=5)
+    eng = ContinuousBatchingEngine(model, mesh, params, num_slots=2,
+                                   buf_len=BUF, eos_id=EOS, prefill_bucket=8)
+    eng.submit(Request(rid=0, prompt=[0, 5, 9], max_new=0))
+    eng.submit(Request(rid=1, prompt=[0, 5, 9], max_new=4, seed=0))
+    eng.run_to_completion()
+    got = {r.rid: r.tokens for r in eng.completed}
+    assert got[0] == []
+    ref = GreedyDecoder(model, mesh, BUF).decode(
+        params, [0, 5, 9], EOS, max_total_len=3 + 4)
+    assert got[1] == ref
+    assert len(got[1]) <= 4
+
+
+# ---- scheduler (pure host logic) ----
+
+
+def test_scheduler_fifo_bucket_groups():
+    """take_batch peels same-bucket PREFIXES off the queue head — strict
+    FIFO admission with bucket-grouped prefill batching."""
+    s = FIFOScheduler(buf_len=64, prefill_bucket=16)
+    lens = [5, 9, 30, 7, 40]     # buckets: 16,16,32,16,48
+    for i, n in enumerate(lens):
+        s.submit(Request(rid=i, prompt=[0] * n, max_new=4))
+    g1 = s.take_batch(8)
+    assert [r.rid for r in g1] == [0, 1]       # stop at first width change
+    assert s.group_width(g1) == 16
+    g2 = s.take_batch(8)
+    assert [r.rid for r in g2] == [2]
+    g3 = s.take_batch(8)
+    assert [r.rid for r in g3] == [3]          # rid 3 never jumped ahead
+    assert [r.rid for r in s.take_batch(8)] == [4]
+    assert s.take_batch(8) == []
+    # max_requests caps the group
+    for i, n in enumerate((4, 4, 4)):
+        s.submit(Request(rid=10 + i, prompt=[0] * n, max_new=4))
+    assert [r.rid for r in s.take_batch(2)] == [10, 11]
+
+
+def test_scheduler_backpressure_and_validation():
+    s = FIFOScheduler(buf_len=32, prefill_bucket=8, max_queue=2)
+    s.submit(Request(rid=0, prompt=[0, 1, 2], max_new=4))
+    s.submit(Request(rid=1, prompt=[0, 1, 2], max_new=4))
+    with pytest.raises(QueueFull, match="full"):
+        s.submit(Request(rid=2, prompt=[0, 1, 2], max_new=4))
+    assert s.rejected == 1
+    with pytest.raises(ValueError, match="leave room"):
+        s.submit(Request(rid=3, prompt=[0] * 32, max_new=4))
+    with pytest.raises(ValueError, match="max_new"):
+        s.submit(Request(rid=4, prompt=[0], max_new=-1))
+    with pytest.raises(ValueError, match="non-empty"):
+        s.submit(Request(rid=5, prompt=[], max_new=4))
+
+
+def test_bucket_width():
+    assert bucket_width(5, 16, 64) == 16
+    assert bucket_width(16, 16, 64) == 16
+    assert bucket_width(17, 16, 64) == 32
+    assert bucket_width(60, 16, 64) == 64      # clamped to the buffer
+    assert bucket_width(5, 0, 64) == 64        # bucketing off = full buffer
+
+
+def test_engine_refuses_cp_models():
+    mesh = make_mesh(MeshConfig(cp=2, tp=2))
+    model = Transformer(CFG, tp_size=2, cp_size=2)
+    with pytest.raises(ValueError, match="cp=1"):
+        ContinuousBatchingEngine(model, mesh, params=None, num_slots=2,
+                                 buf_len=BUF, eos_id=EOS)
+
+
+# ---- the serve CLI smoke (tier-1: the surface cannot rot on CPU) ----
+
+
+def test_serve_dry_run_smoke(tmp_path):
+    from distributed_pytorch_from_scratch_tpu.serving import serve as serve_mod
+
+    log_dir = str(tmp_path / "serve")
+    summary = serve_mod.main(["--dry_run", "--log_dir", log_dir])
+    assert summary["completed"] == summary["requests"] > 0
+    assert summary["tokens_per_sec"] > 0
+    assert summary["ttft_ms_p50"] is not None
+    # metrics events reached the writer (summarize_run.py's input)
+    tags = [json.loads(l)["tag"]
+            for l in open(os.path.join(log_dir, "metrics.jsonl"))]
+    assert "serving_summary" in tags
+    assert "serve_request" in tags
+    # the Chrome trace finalised with prefill/decode spans
+    trace = json.load(open(os.path.join(log_dir, "trace.json")))
+    names = {ev.get("name") for ev in trace["traceEvents"]}
+    assert "prefill" in names and "decode_step" in names
+    # and summarize_run.py renders the serving section from it
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_sr", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "summarize_run.py"))
+    sr = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sr)
+    lines = sr.serving_lines(str(tmp_path))
+    assert len(lines) == 1 and "TTFT p50/p95" in lines[0]

@@ -23,9 +23,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 R5 = os.path.join(REPO, "runs", "r5")
 
 # every staged session dir gets preflighted (r6 stages the fast-45m pass,
-# r7 the comm-overlap A/B)
+# r7 the comm-overlap A/B, r8 the serving loadgen sweep)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
-                            os.path.join(REPO, "runs", "r7"))
+                            os.path.join(REPO, "runs", "r7"),
+                            os.path.join(REPO, "runs", "r8"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
@@ -155,6 +156,10 @@ def validate(argv):
             from distributed_pytorch_from_scratch_tpu.data.tokenizer import (
                 parse_args)
             return _parse_with(parse_args, rest)
+        if mod == "distributed_pytorch_from_scratch_tpu.serving.serve":
+            from distributed_pytorch_from_scratch_tpu.serving.serve import (
+                get_serve_args)
+            return _parse_with(get_serve_args, rest)
         pytest.fail(f"staged module has no registered parser: {mod}")
     # script path
     path = os.path.join(REPO, prog)
