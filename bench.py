@@ -155,9 +155,15 @@ def parse_args(argv=None):
     p.add_argument("--slots", type=int, default=8,
                    help="--serving: KV-pool slots (= the one-shot "
                         "baseline's batch size, so the comparison is "
-                        "concurrency-controlled)")
+                        "concurrency-controlled; also fixes the paged "
+                        "engine's page budget: slots x buf_len tokens)")
     p.add_argument("--serve_requests", type=int, default=24,
                    help="--serving: requests in the burst")
+    p.add_argument("--page_size", type=int, default=64,
+                   help="--serving: paged-engine KV page size (tokens)")
+    p.add_argument("--prefill_chunk", type=int, default=128,
+                   help="--serving: paged-engine prefill chunk (positions "
+                        "per dispatch interleaved into the decode loop)")
     args = p.parse_args(argv)
     if args.serving and (args.decode or args.breakdown):
         p.error("--serving excludes --decode/--breakdown")
@@ -336,23 +342,31 @@ def run_decode_bench(args, mesh, cfg, tp: int) -> None:
 
 
 def run_serving_bench(args, mesh, cfg, tp: int) -> None:
-    """Continuous-batching serving throughput vs one-shot batch decode.
+    """Serving A/B: PAGED engine vs the PR 5 slot engine at EQUAL HBM
+    budget, both vs one-shot batch decode.
 
-    The SAME burst of mixed-length requests goes through (a) the serving
-    engine at --slots concurrency (slots retire and refill as rows finish)
-    and (b) one-shot GreedyDecoder batches of --slots rows (every batch
-    pads to the longest prompt and waits for its slowest row — today's
-    generate.py-before-this-PR behaviour). vs_baseline = a / b in
-    aggregate tokens/s. Random init + random-id prompts (cost depends on
-    shapes, not values). First-touch compiles are included in both sides'
-    walls; the engine's prefill variants are bounded by the bucket count.
-    """
+    The same long/short INTERLEAVED burst (alternating prompt_len/4 and
+    prompt_len prompts — the head-of-line-prefill stress) goes through:
+
+    (a) the paged engine (serving v2): page budget = slots x buf_len
+        tokens — the SAME bytes the slot engine spends — but leased as
+        pages, so short requests admit past the slot count, long prompts
+        prefill in chunks, and identical prefixes share pages;
+    (b) the slot engine at --slots rows of buf_len (PR 5's shape);
+    (c) one-shot GreedyDecoder batches of --slots rows (the
+        pre-serving baseline; every batch pads to its slowest row).
+
+    vs_baseline = paged / one-shot aggregate tokens/s; `paged_vs_slot`
+    and the per-engine TTFT p95 + max sustained concurrency are the A/B
+    the page table exists to win. Random init + random-id prompts (cost
+    depends on shapes, not values); first-touch compiles are included in
+    every side's wall."""
     import numpy as np
 
     from distributed_pytorch_from_scratch_tpu.models.decode import (
         GreedyDecoder)
     from distributed_pytorch_from_scratch_tpu.serving.engine import (
-        ContinuousBatchingEngine)
+        ContinuousBatchingEngine, PagedEngine)
     from distributed_pytorch_from_scratch_tpu.serving.loadgen import (
         run_loadgen, synthetic_requests)
 
@@ -368,21 +382,40 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
                             model.shardings(mesh))
     buf_len = plen + gen + 2
     eos = 1  # the shipped tokenizer's EOS (tokenizer/tokenizer.json)
-    requests = synthetic_requests(
-        args.serve_requests, max(3, plen // 2), plen, gen, cfg.vocab_size,
-        seed=2, arrival="burst")
 
+    def burst():
+        # fresh Request objects each time — engines mutate them
+        return synthetic_requests(
+            args.serve_requests, max(3, plen // 4), plen, gen,
+            cfg.vocab_size, seed=2, arrival="burst", interleave=True)
+
+    # (a) paged at the slot engine's HBM budget. FLOOR division: the slot
+    # engine owns slots x buf_len token positions, and rounding the page
+    # count UP would hand the paged side up to page_size-1 extra tokens
+    # per slot — the A/B must pay paging's tail-page fragmentation out of
+    # the SAME bytes, not out of extra budget. (Clamped so one worst-case
+    # request still fits, else --slots 1 would refuse every submit.)
+    num_pages = max(-(-buf_len // args.page_size),
+                    (args.slots * buf_len) // args.page_size)
+    paged = PagedEngine(
+        model, mesh, params, num_slots=args.serve_requests, buf_len=buf_len,
+        eos_id=eos, page_size=args.page_size, num_pages=num_pages,
+        prefill_chunk=args.prefill_chunk)
+    paged_summary = run_loadgen(paged, burst())
+    paged_rate = paged_summary["tokens_per_sec"]
+
+    # (b) the PR 5 slot engine
     engine = ContinuousBatchingEngine(
         model, mesh, params, num_slots=args.slots, buf_len=buf_len,
         eos_id=eos, prefill_bucket=128)
-    summary = run_loadgen(engine, requests)
+    summary = run_loadgen(engine, burst())
     serve_rate = summary["tokens_per_sec"]
 
-    # one-shot baseline: the same prompts in GreedyDecoder batches of
+    # (c) one-shot baseline: the same prompts in GreedyDecoder batches of
     # --slots (the final ragged batch repeats its last prompt to keep one
     # compiled shape; pad-row outputs are not counted)
     dec = GreedyDecoder(model, mesh, buf_len)
-    prompts = [r.prompt for r in requests]
+    prompts = [r.prompt for r in burst()]
     B = args.slots
     t0 = time.time()
     oneshot_tokens = 0
@@ -398,30 +431,49 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
 
     fmt = lambda v: "-" if v is None else f"{v:.0f}"
     print(f"bench[serving {args.model} {args.family}]: "
-          f"{summary['completed']}/{summary['requests']} requests, "
-          f"slots={args.slots}, {serve_rate:.0f} tok/s continuous vs "
-          f"{oneshot_rate:.0f} tok/s one-shot batches "
-          f"({oneshot_tokens} tokens in {oneshot_s*1000:.0f}ms); TTFT "
-          f"p50/p95 {fmt(summary['ttft_ms_p50'])}/"
-          f"{fmt(summary['ttft_ms_p95'])}ms, occupancy "
-          f"{summary['slot_occupancy_mean']:.2f}", file=sys.stderr)
+          f"{args.serve_requests}-request long/short interleave — paged "
+          f"{paged_rate:.0f} tok/s (TTFT p95 "
+          f"{fmt(paged_summary['ttft_ms_p95'])}ms, max live "
+          f"{paged_summary['max_live']}, kv util "
+          f"{paged_summary['kv_util_mean']:.2f}, prefix hits "
+          f"{100 * paged_summary['prefix_hit_rate']:.0f}%, "
+          f"{paged_summary['preemptions']} preempted) vs slot "
+          f"{serve_rate:.0f} tok/s (TTFT p95 "
+          f"{fmt(summary['ttft_ms_p95'])}ms, {args.slots} slots) vs "
+          f"one-shot {oneshot_rate:.0f} tok/s "
+          f"({oneshot_tokens} tokens in {oneshot_s*1000:.0f}ms); equal "
+          f"HBM budget: {num_pages} pages x {args.page_size} = "
+          f"{args.slots} slots x {buf_len}", file=sys.stderr)
     print(json.dumps({
         "metric": (f"serving tokens/sec ({args.model} {args.family}, "
-                   f"slots={args.slots}, {args.serve_requests}-request "
-                   f"burst, prompt<=~{plen}, gen {gen}; vs_baseline = "
-                   f"speedup over one-shot b{args.slots} GreedyDecoder "
-                   f"batches of the same request set)"),
-        "value": round(serve_rate, 1),
+                   f"PAGED at {num_pages}x{args.page_size}-token pages = "
+                   f"slots{args.slots} HBM, {args.serve_requests}-request "
+                   f"long/short burst, prompt {max(3, plen // 4)}/{plen}, "
+                   f"gen {gen}; vs_baseline = speedup over one-shot "
+                   f"b{args.slots} GreedyDecoder batches; paged_vs_slot = "
+                   f"A/B against the slot engine at equal HBM)"),
+        "value": round(paged_rate, 1),
         "unit": "tokens/sec (serving)",
-        "vs_baseline": round(serve_rate / max(oneshot_rate, 1e-9), 3),
+        "vs_baseline": round(paged_rate / max(oneshot_rate, 1e-9), 3),
+        "paged_vs_slot": round(paged_rate / max(serve_rate, 1e-9), 3),
         "oneshot_rate": round(oneshot_rate, 1),
-        "slot_occupancy_mean": summary["slot_occupancy_mean"],
-        "ttft_ms_p50": summary["ttft_ms_p50"],
-        "ttft_ms_p95": summary["ttft_ms_p95"],
-        "tpot_ms_p50": summary["tpot_ms_p50"],
-        "tpot_ms_p95": summary["tpot_ms_p95"],
-        "prefill_pad_waste_eliminated":
-            summary["prefill_pad_waste_eliminated"],
+        "ttft_ms_p50": paged_summary["ttft_ms_p50"],
+        "ttft_ms_p95": paged_summary["ttft_ms_p95"],
+        "tpot_ms_p50": paged_summary["tpot_ms_p50"],
+        "tpot_ms_p95": paged_summary["tpot_ms_p95"],
+        "queue_wait_ms_p95": paged_summary["queue_wait_ms_p95"],
+        "max_live": paged_summary["max_live"],
+        "kv_util_mean": paged_summary["kv_util_mean"],
+        "prefix_hit_rate": paged_summary["prefix_hit_rate"],
+        "preemptions": paged_summary["preemptions"],
+        "slo_attainment": paged_summary.get("slo_attainment"),
+        "slot_engine": {
+            "tokens_per_sec": round(serve_rate, 1),
+            "slots": args.slots,
+            "ttft_ms_p95": summary["ttft_ms_p95"],
+            "queue_wait_ms_p95": summary["queue_wait_ms_p95"],
+            "slot_occupancy_mean": summary["slot_occupancy_mean"],
+        },
     }))
 
 

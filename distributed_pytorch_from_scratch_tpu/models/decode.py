@@ -289,6 +289,162 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
     return k_new, v_new, _logits_last(model, params, x, dtype)
 
 
+def _gather_page_view(cache: jax.Array, page_tbl: jax.Array) -> jax.Array:
+    """Page pool layer slice (pages, kvh, page, hd) + per-row page lists
+    (b, max_pages) -> the dense logical cache view (b, kvh, max_pages*page,
+    hd) the attention einsums consume.
+
+    The gathered view is VALUE-identical to a slot-granular cache row at
+    every position a request has written (pages hold exactly the K/V the
+    prefill/decode scatters put there); positions beyond the cursor gather
+    whatever the mapped page holds (a freshly allocated page's zeros, the
+    scratch page, or a COW donor's later tokens) — all finite, all masked
+    to exact-zero attention weight before anything reads them, the same
+    garbage-flows-only-into-garbage argument as the slot engine's free
+    rows."""
+    b, mp = page_tbl.shape
+    _, kvh, ps, hd = cache.shape
+    view = cache[page_tbl]                      # (b, mp, kvh, ps, hd)
+    return view.transpose(0, 2, 1, 3, 4).reshape(b, kvh, mp * ps, hd)
+
+
+def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
+                      token: jax.Array, cur: jax.Array, page_tbl: jax.Array,
+                      page_size: int, cos_t, sin_t, dtype):
+    """`_decode_one` through a page table: one single-token step where each
+    row's K/V write lands in the PAGE mapped for its cursor position
+    (pool.at[page, :, offset, :]) and the attention reads the dense view
+    gathered from the row's page list. The attend math (grouped einsum,
+    MASK_VALUE mask, f32 scores) is the same block `_decode_one` lowers, so
+    at equal logical buffer length the paged step is value-identical to the
+    slot-granular step over the same written K/V.
+
+    pool_k/pool_v: (L, num_pages+1, kvh, page_size, hd); page_tbl:
+    (b, max_pages) int32 page ids (free rows map every entry at the scratch
+    page, whose content is never attended)."""
+    b = token.shape[0]
+    mp = page_tbl.shape[1]
+    buf_len = mp * page_size
+    cur = jnp.asarray(cur, jnp.int32)
+    p1 = cur[:, None]
+    x = _embed(model, params, token[:, None], p1, dtype)
+    if model.uses_rope:
+        cos = jnp.take(cos_t, p1, axis=0, mode="clip")
+        sin = jnp.take(sin_t, p1, axis=0, mode="clip")
+    visible = (jnp.arange(buf_len)[None, :] <= cur[:, None])[:, None, None, :]
+    rows = jnp.arange(b)
+    # the physical destination of each row's write: its cursor's page + the
+    # offset inside that page (free rows' tables aim at the scratch page)
+    dst_page = page_tbl[rows, cur // page_size]        # (b,)
+    dst_off = cur % page_size                          # (b,)
+
+    def write_cache(cache, z):
+        # per-row scatter into the page pool (row i writes page dst_page[i]
+        # at offset dst_off[i]); duplicate scratch targets are harmless —
+        # the scratch page is never read
+        return cache.at[dst_page, :, dst_off, :].set(
+            z[:, :, 0, :].astype(cache.dtype))
+
+    def body(x, layer_in):
+        lp, k_cache, v_cache = layer_in
+        nk = model.attn_norm_key
+        y = model._mods[nk].apply(lp[nk], x)
+        q, k, v = _qkv(model, lp, y, dtype)   # q: (b, h, 1, hd); kv: kvh
+        if model.uses_rope:
+            q, k = apply_rotary(q, k, cos, sin)
+        k_cache = write_cache(k_cache, k)
+        v_cache = write_cache(v_cache, v)
+        k_view = _gather_page_view(k_cache, page_tbl)
+        v_view = _gather_page_view(v_cache, page_tbl)
+        # identical attend block to _decode_one (same einsums, same mask,
+        # same f32 scores) — only the cache OPERAND is gathered, not sliced
+        kvh = model.num_local_kv_heads
+        g = model.num_local_heads // kvh
+        hd = model.cfg.head_dim
+        qg = q[:, :, 0, :].reshape(b, kvh, g, hd)
+        s = jnp.einsum("bkgd,bktd->bkgt", qg, k_view,
+                       preferred_element_type=jnp.float32)
+        s = s / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        s = jnp.where(visible, s, MASK_VALUE)
+        p = jax.nn.softmax(s, axis=-1).astype(dtype)
+        o = jnp.einsum("bkgt,bktd->bkgd", p, v_view)
+        o = o.reshape(b, kvh * g, hd)[:, :, None, :]   # (b, h, 1, hd)
+        x = _finish_block(model, lp, x, o, dtype)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], pool_k, pool_v))
+    return k_new, v_new, _logits_last(model, params, x, dtype)
+
+
+def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
+                         chunk: jax.Array, start: jax.Array,
+                         qlen: jax.Array, page_tbl: jax.Array,
+                         dst_page: jax.Array, dst_off: jax.Array,
+                         page_size: int, cos_t, sin_t, dtype):
+    """One CHUNK of an incremental prefill: process `chunk` (b, cw) tokens
+    occupying absolute positions start..start+qlen-1 (columns >= qlen are
+    pad), write their K/V into the pages `dst_page`/`dst_off` (b, cw) map
+    (pad columns aim at the scratch page), and attend each chunk query over
+    the row's FULL gathered page view — prior chunks, a COW-shared prefix
+    prefilled by another request, and the chunk's own earlier positions all
+    arrive through the same page table. Returns the per-row logits at the
+    chunk's LAST real position (qlen-1), which for the final chunk of a
+    prompt are the first-token sampling logits.
+
+    This is `_paged_decode_one` generalised from 1 query to cw queries:
+    position p's activations depend only on positions <= p (causality), so
+    chunk-at-a-time prefill is value-identical to the whole-buffer
+    `_prefill` — chunking changes cost and stall, never tokens."""
+    b, cw = chunk.shape
+    mp = page_tbl.shape[1]
+    buf_len = mp * page_size
+    pos = start[:, None] + jnp.arange(cw, dtype=jnp.int32)[None, :]  # (b, cw)
+    x = _embed(model, params, chunk, pos, dtype)
+    if model.uses_rope:
+        cos = jnp.take(cos_t, pos, axis=0, mode="clip")
+        sin = jnp.take(sin_t, pos, axis=0, mode="clip")
+    # query at (row, i) sees cache position t iff t <= start[row] + i;
+    # everything later (incl. garbage pages) masks to exact-zero weight
+    visible = (jnp.arange(buf_len)[None, None, :]
+               <= pos[:, :, None])[:, None, None, :, :]  # (b,1,1,cw,T)
+
+    def write_cache(cache, z):
+        # z: (b, kvh, cw, hd) -> scatter token i of row r to
+        # cache[dst_page[r, i], :, dst_off[r, i], :]
+        return cache.at[dst_page, :, dst_off, :].set(
+            z.transpose(0, 2, 1, 3).astype(cache.dtype))
+
+    def body(x, layer_in):
+        lp, k_cache, v_cache = layer_in
+        nk = model.attn_norm_key
+        y = model._mods[nk].apply(lp[nk], x)
+        q, k, v = _qkv(model, lp, y, dtype)   # q: (b, h, cw, hd)
+        if model.uses_rope:
+            q, k = apply_rotary(q, k, cos, sin)
+        k_cache = write_cache(k_cache, k)
+        v_cache = write_cache(v_cache, v)
+        k_view = _gather_page_view(k_cache, page_tbl)
+        v_view = _gather_page_view(v_cache, page_tbl)
+        kvh = model.num_local_kv_heads
+        g = model.num_local_heads // kvh
+        hd = model.cfg.head_dim
+        qg = q.reshape(b, kvh, g, cw, hd)
+        s = jnp.einsum("bkgqd,bktd->bkgqt", qg, k_view,
+                       preferred_element_type=jnp.float32)
+        s = s / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        s = jnp.where(visible, s, MASK_VALUE)
+        p = jax.nn.softmax(s, axis=-1).astype(dtype)
+        o = jnp.einsum("bkgqt,bktd->bkgqd", p, v_view)
+        o = o.reshape(b, kvh * g, cw, hd)
+        x = _finish_block(model, lp, x, o, dtype)
+        return x, (k_cache, v_cache)
+
+    x, (k_new, v_new) = lax.scan(body, x, (params["layers"], pool_k, pool_v))
+    last = jnp.take_along_axis(
+        x, jnp.maximum(qlen - 1, 0)[:, None, None].astype(jnp.int32), axis=1)
+    return k_new, v_new, _logits_last(model, params, last, dtype)
+
+
 def validate_sampling(cfg, temperature: float, top_k: int,
                       top_p: float) -> None:
     """Build-time sampling-knob validation shared by `make_generate` and the

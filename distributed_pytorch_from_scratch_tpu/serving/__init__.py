@@ -6,17 +6,27 @@ opens the INFERENCE workload: a slot-granular KV-cache pool
 the one-shot decoder's lowerings (`engine.py` — greedy output is
 token-identical to `models/decode.GreedyDecoder`), a FIFO scheduler with
 length-bucketed prefill batching (`scheduler.py`), a Poisson/burst/replay
-arrival driver (`loadgen.py`), and the `serve.py` benchmark CLI. See
-docs/SERVING.md.
+arrival driver (`loadgen.py`), and the `serve.py` benchmark CLI.
+
+Serving v2 (ISSUE 6) adds the PAGED path: `PagedKVPool` (fixed-size KV
+pages, refcounts, COW prefix index), `PagedEngine` (page-table decode,
+chunked prefill interleaved into the decode loop, preemption with
+resume-through-prefill), and `SLOScheduler` (TTFT deadline classes,
+per-tenant fairness). Same token-identity bar as v1, pinned in
+tests/test_serving_paged.py. See docs/SERVING.md.
 """
 
-from .engine import ContinuousBatchingEngine, Request, decode_prompts
-from .kv_manager import KVCachePool
-from .loadgen import run_loadgen, synthetic_requests
-from .scheduler import FIFOScheduler, QueueFull, bucket_width
+from .engine import (ContinuousBatchingEngine, PagedEngine, Request,
+                     decode_prompts)
+from .kv_manager import KVCachePool, PagedKVPool, PoolExhausted
+from .loadgen import run_loadgen, slo_attainment, synthetic_requests
+from .scheduler import (DEFAULT_SLO_CLASSES, FIFOScheduler, QueueFull,
+                        SLOScheduler, bucket_width, parse_slo_classes)
 
 __all__ = [
-    "ContinuousBatchingEngine", "FIFOScheduler", "KVCachePool", "QueueFull",
-    "Request", "bucket_width", "decode_prompts", "run_loadgen",
+    "ContinuousBatchingEngine", "DEFAULT_SLO_CLASSES", "FIFOScheduler",
+    "KVCachePool", "PagedEngine", "PagedKVPool", "PoolExhausted",
+    "QueueFull", "Request", "SLOScheduler", "bucket_width",
+    "decode_prompts", "parse_slo_classes", "run_loadgen", "slo_attainment",
     "synthetic_requests",
 ]

@@ -35,6 +35,7 @@ run renders in the same Chrome trace / summary pipeline as training.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -43,24 +44,28 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..models.decode import (_decode_one, _prefill, make_token_sampler,
-                             rope_tables)
+from ..models.decode import (_decode_one, _paged_decode_one,
+                             _paged_prefill_chunk, _prefill,
+                             make_token_sampler, rope_tables)
 from ..config import resolve_dtype
-from .kv_manager import KVCachePool, POOL_SPEC
-from .scheduler import FIFOScheduler
+from .kv_manager import (KVCachePool, POOL_SPEC, PagedKVPool, PoolExhausted)
+from .scheduler import FIFOScheduler, SLOScheduler
 
 
 @dataclass
 class Request:
     """One generation request. `tokens` fills with the generated ids (EOS
     excluded, like GreedyDecoder.decode); the *_t fields are engine-clock
-    samples for the serving metrics."""
+    samples for the serving metrics. `tenant`/`slo_class` drive the paged
+    engine's SLO scheduler (the FIFO scheduler ignores them)."""
 
     rid: int
     prompt: List[int]
     max_new: int
     seed: int = 0
     arrival: float = 0.0                 # loadgen's planned arrival offset
+    tenant: str = "default"              # fair-queuing bucket (SLOScheduler)
+    slo_class: Optional[str] = None      # TTFT deadline class (None=default)
     tokens: List[int] = field(default_factory=list)
     submit_t: Optional[float] = None     # entered the admission queue
     admit_t: Optional[float] = None      # left the queue (prefill dispatch)
@@ -68,6 +73,8 @@ class Request:
     finish_t: Optional[float] = None
     prompt_len: int = 0
     limit: int = 0
+    deadline_t: Optional[float] = None   # submit_t + class TTFT budget
+    preemptions: int = 0                 # times evicted and re-queued
 
     # -- derived metrics (seconds; None until the request finishes) ------
     @property
@@ -386,4 +393,571 @@ class ContinuousBatchingEngine:
             "prefill_pad_waste_eliminated": round(
                 1.0 - self.prefill_positions / mono, 4)
             if self.prefill_positions_monolithic else 0.0,
+        }
+
+
+@dataclass
+class _PrefillState:
+    """Host-side cursor of an in-flight (chunked) prefill: `ids` is the
+    full token prefix to materialise (prompt, plus any tokens a preempted
+    request had already generated — the resume-through-prefill path),
+    `s` the next position to process, `keys` the page-aligned prefix-index
+    chain keys for registration."""
+
+    req: Request
+    ids: List[int]
+    s: int
+    keys: List[object] = field(default_factory=list)
+
+
+class PagedEngine:
+    """Continuous batching over a PAGED KV cache (serving v2, ISSUE 6).
+
+    Same host-driven loop as `ContinuousBatchingEngine` — retire, admit,
+    one decode dispatch — but the cache is a pool of fixed-size PAGES
+    (`kv_manager.PagedKVPool`) indexed through a shape-stable
+    `(slots, max_pages)` page table, which buys three things the slot
+    engine cannot do:
+
+    * **capacity = live tokens, not worst-case rows**: a slot leases pages
+      as its cursor grows, so a mixed-length burst fits in the same HBM
+      budget that the slot engine spends on `slots x buf_len` whatever the
+      prompts actually are (`num_pages` is the budget; oversubscribing
+      slots past it is the point).
+    * **copy-on-write prefix reuse**: identical prompt prefixes (system
+      prompts, few-shot headers) prefill ONCE — later arrivals reference
+      the donor's pages through the pool's prefix index and only
+      materialise a private copy when they WRITE into a shared page.
+    * **chunked prefill**: a long prompt prefills `prefill_chunk` tokens
+      at a time, interleaved into the decode loop, so a live stream's
+      TPOT never stalls by more than one chunk
+      (`max_interleaved_prefill_positions` in stats() is the measured
+      bound).
+
+    Admission is `scheduler.SLOScheduler` (TTFT deadline classes,
+    per-tenant fairness, overdue-EDF rescue); when an overdue request
+    cannot be admitted — or a live slot cannot grow a page — a victim from
+    a looser deadline class (most generated tokens first: the most
+    over-budget work) is PREEMPTED: its pages are freed, and it re-enters
+    the queue with its generated prefix re-admitted through the COW path
+    (greedy decode restarted from prompt+generated is token-identical to
+    the uninterrupted run — per-position math depends only on the prefix).
+
+    Token-identity contract: greedy paged output equals the slot engine's
+    (and per-prompt GreedyDecoder's) for every request, across page
+    sizes, arrival orders, COW sharing, chunking, and preemption — the
+    decode/chunk lowerings reuse `_decode_one`'s attend math over a
+    gathered page view (`models/decode._paged_decode_one`,
+    `_paged_prefill_chunk`), pinned in tests/test_serving_paged.py."""
+
+    def __init__(self, model, mesh: Mesh, params, num_slots: int,
+                 buf_len: int, eos_id: int, page_size: int = 64,
+                 num_pages: int = 0, prefill_chunk: int = 128,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 slo_classes=None, default_class: str = "standard",
+                 max_queue: int = 0, tracer=None, writer=None,
+                 clock=time.monotonic):
+        if getattr(model, "cp_size", 1) > 1:
+            raise ValueError(
+                "the serving engine decodes on the cp=1 path (per-slot "
+                "caches are replicated over cp); long-context cp prefill "
+                "stays with models/decode.GreedyDecoder — rebuild the "
+                f"model with cp_size=1 (got {model.cp_size})")
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, got "
+                             f"{prefill_chunk}")
+        # the logical per-request buffer rounds UP to whole pages; the
+        # dense gathered view is max_pages * page_size wide
+        self.page_size = page_size
+        self.max_pages = -(-buf_len // page_size)
+        self.buf_len = self.max_pages * page_size
+        cap = getattr(model, "max_decode_positions", None)
+        if cap is not None and self.buf_len > cap:
+            raise ValueError(
+                f"buf_len {self.buf_len} ({self.max_pages} pages of "
+                f"{page_size}) exceeds the model's learned position table "
+                f"({cap}); clamp the buffer or retrain with a larger maxlen")
+        if not num_pages:
+            num_pages = num_slots * self.max_pages  # no oversubscription
+        self.model = model
+        self.mesh = mesh
+        self.params = params
+        self.num_slots = num_slots
+        self.eos_id = int(eos_id)
+        self.prefill_chunk = prefill_chunk
+        self._clock = clock
+        self.tracer = tracer
+        self.writer = writer
+        self._dtype = resolve_dtype(model.cfg.compute_dtype)
+        self._table_len = max(model.cfg.maxlen, self.buf_len)
+        self._sample = make_token_sampler(model, temperature=temperature,
+                                          top_k=top_k, top_p=top_p)
+        self.pool = PagedKVPool(model, mesh, num_pages, page_size)
+        self.scheduler = SLOScheduler(self.buf_len, classes=slo_classes,
+                                      default_class=default_class,
+                                      max_queue=max_queue, clock=clock)
+        self._free_slots = deque(range(num_slots))
+        # (slots, max_pages) page table; free rows aim at the scratch page
+        self._tbl = np.full((num_slots, self.max_pages),
+                            self.pool.scratch_page, np.int32)
+        self._tokens = np.zeros(num_slots, np.int32)
+        self._pos = np.zeros(num_slots, np.int32)
+        self._seeds = np.zeros(num_slots, np.uint32)
+        self._slot_req: Dict[int, Request] = {}
+        self._prefilling: Dict[int, _PrefillState] = {}
+        self._step_fn = self._build_step()
+        self._chunk_fns: Dict[int, object] = {}
+        self.completed: List[Request] = []
+        # -- aggregate stats ---------------------------------------------
+        self.decode_steps = 0
+        self.generated_tokens = 0
+        self.prompt_tokens = 0
+        self.prefill_positions = 0          # positions actually dispatched
+        self.prefill_token_demand = 0       # Σ len(ids) at admissions
+        self.prefix_hit_tokens = 0          # positions served from shared pages
+        self.preemptions = 0
+        self.max_live = 0
+        self.max_interleaved_prefill = 0    # the chunk stall bound, measured
+        self._occupancy_sum = 0.0
+        self._kv_util_sum = 0.0
+        self._pages_used_sum = 0
+
+    # -- compiled programs ------------------------------------------------
+    def _tables(self):
+        if not self.model.uses_rope:
+            return None, None
+        return rope_tables(self._table_len, self.model.cfg.head_dim,
+                           self.model.cfg.rope_theta)
+
+    def _build_step(self):
+        model, ps, dtype = self.model, self.page_size, self._dtype
+
+        def shard_fn(params, pool_k, pool_v, tokens, pos, seeds, tbl):
+            cos_t, sin_t = self._tables()
+            pool_k, pool_v, logits = _paged_decode_one(
+                model, params, pool_k, pool_v, tokens, pos, tbl, ps,
+                cos_t, sin_t, dtype)
+            tok = self._sample(logits, seeds, pos + 1)
+            return pool_k, pool_v, tok
+
+        fn = jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None), P(None),
+                      P(None), P(None, None)),
+            out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _build_chunk(self, cw: int):
+        model, ps, dtype = self.model, self.page_size, self._dtype
+
+        def shard_fn(params, pool_k, pool_v, chunk, start, qlen, tbl,
+                     dstp, dsto, seeds):
+            cos_t, sin_t = self._tables()
+            pool_k, pool_v, logits = _paged_prefill_chunk(
+                model, params, pool_k, pool_v, chunk, start, qlen, tbl,
+                dstp, dsto, ps, cos_t, sin_t, dtype)
+            tok = self._sample(logits, seeds, start + qlen)
+            return pool_k, pool_v, tok
+
+        fn = jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None, None),
+                      P(None), P(None), P(None, None), P(None, None),
+                      P(None, None), P(None)),
+            out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    # -- request intake ---------------------------------------------------
+    def submit(self, req: Request) -> None:
+        """Enqueue through the SLO scheduler (QueueFull past the
+        backpressure bound). Refuses up front a request whose WORST-CASE
+        private footprint cannot fit the page pool — admitted, it would
+        deadlock preemption once it became the only live request."""
+        need = -(-min(len(req.prompt) + req.max_new, self.buf_len)
+                 // self.page_size)
+        if need > self.pool.num_pages:
+            raise ValueError(
+                f"request {req.rid}: needs up to {need} pages "
+                f"({len(req.prompt)}+{req.max_new} tokens / page_size "
+                f"{self.page_size}) but the pool has {self.pool.num_pages} "
+                f"— raise --num_pages or lower the budget")
+        self.scheduler.submit(req)
+
+    def has_work(self) -> bool:
+        return bool(self.scheduler.pending or self._slot_req
+                    or self._prefilling)
+
+    @property
+    def live_requests(self) -> int:
+        return len(self._slot_req) + len(self._prefilling)
+
+    # -- the engine loop --------------------------------------------------
+    def step(self) -> List[Request]:
+        """One iteration: admit (slots + shared-prefix match), pump AT MOST
+        one chunk of prefill while streams are live (the TPOT stall
+        bound), then advance every live slot one token."""
+        done: List[Request] = []
+        self._admit(done)
+        self._pump_prefill(done)
+        if self._slot_req:
+            self._decode(done)
+        self.max_live = max(self.max_live, self.live_requests)
+        return done
+
+    def run_to_completion(self) -> List[Request]:
+        out: List[Request] = []
+        while self.has_work():
+            out.extend(self.step())
+        return out
+
+    # -- internals --------------------------------------------------------
+    def _span(self, name, **args):
+        if self.tracer is not None:
+            return self.tracer.span(name, cat="serve", **args)
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _chain_keys(self, ids: List[int]) -> List[object]:
+        """Prefix-index chain keys for every page-aligned run of `ids`
+        (the last may be partial)."""
+        ps, keys, parent = self.page_size, [], None
+        for j in range(-(-len(ids) // ps)):
+            parent = self.pool.chain_key(parent, ids[j * ps:(j + 1) * ps])
+            keys.append(parent)
+        return keys
+
+    def _try_share(self, slot: int, st: _PrefillState) -> None:
+        """At a page boundary, extend the slot's prefix through the pool's
+        index instead of recomputing it: a donor page whose valid tokens
+        lead-match the remaining ids is referenced in place (refcount++),
+        and the cursor jumps past the shared run. A partial match (shorter
+        donor tail, or a divergence inside the page) still shares the
+        matched positions — visibility masks the rest — but ends the walk.
+        Capped at len(ids)-1 so at least one position is always recomputed
+        (its logits seed the first sampled token). Runs before every chunk
+        dispatch, so a donor admitted in the SAME step is found as soon as
+        its pages register."""
+        ps = self.page_size
+        while st.s % ps == 0:
+            cap = len(st.ids) - 1 - st.s
+            if cap <= 0:
+                break
+            j = st.s // ps
+            parent = st.keys[j - 1] if j else None
+            window = st.ids[st.s:st.s + min(ps, cap)]
+            best_page, best_len = None, 0
+            for page, toks in self.pool.children(parent):
+                n = 0
+                for a, b in zip(toks, window):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best_len:
+                    best_page, best_len = page, n
+            if best_len == 0:
+                break
+            self.pool.ref(best_page)
+            self._tbl[slot, j] = best_page
+            st.s += best_len
+            self.prefix_hit_tokens += best_len
+            if best_len < ps:
+                break                      # partial match ends the walk
+
+    def _admit(self, done: List[Request]) -> None:
+        while self._free_slots or self.scheduler.pending:
+            req = self.scheduler.peek()
+            if req is None:
+                break
+            now = self._clock()
+            overdue = req.deadline_t is not None and now >= req.deadline_t
+            if not self._free_slots:
+                # an overdue head may evict a looser-class victim
+                if not (overdue and self._preempt_for(req)):
+                    break
+                continue
+            ids = req.prompt + req.tokens
+            # gate on the pages the FIRST chunk needs (conservative: prefix
+            # sharing, resolved at chunk time, can only reduce it), so a
+            # freshly admitted request never instantly deadlocks the pump
+            need = -(-min(len(ids), self.prefill_chunk) // self.page_size)
+            if need > self.pool.free_pages:
+                if not (overdue and self._preempt_for(req)):
+                    break
+                continue
+            self.scheduler.take()
+            if req.admit_t is None:
+                req.admit_t = now
+                req.prompt_len = len(req.prompt)
+                req.limit = min(req.prompt_len + req.max_new, self.buf_len)
+                self.prompt_tokens += req.prompt_len
+            if req.limit <= len(ids):      # max_new == 0
+                req.finish_t = now
+                self._complete(req, done)
+                continue
+            slot = self._free_slots.popleft()
+            self.prefill_token_demand += len(ids)
+            st = _PrefillState(req, ids, 0)
+            st.keys = self._chain_keys(ids)
+            self._prefilling[slot] = st
+
+    def _candidates(self, exclude_slot=None):
+        """Live + prefilling requests preemption may evict, worst first:
+        loosest deadline class, then most generated tokens (the most
+        over-budget work), then latest admission."""
+        cands = []
+        for slot, req in self._slot_req.items():
+            if slot != exclude_slot:
+                cands.append((slot, req))
+        for slot, st in self._prefilling.items():
+            if slot != exclude_slot:
+                cands.append((slot, st.req))
+        classes = self.scheduler.classes
+        cands.sort(key=lambda sr: (-classes.get(sr[1].slo_class, 0.0),
+                                   -len(sr[1].tokens),
+                                   -(sr[1].admit_t or 0.0)))
+        return cands
+
+    def _preempt_for(self, req) -> bool:
+        """Evict one victim from a STRICTLY looser deadline class than
+        `req` (same-class work is never displaced — that would ping-pong).
+        Returns True when something was freed."""
+        classes = self.scheduler.classes
+        bound = classes[req.slo_class or self.scheduler.default_class]
+        for slot, victim in self._candidates():
+            if classes.get(victim.slo_class, 0.0) > bound:
+                self._preempt(slot)
+                return True
+        return False
+
+    def _preempt(self, slot: int) -> None:
+        """Evict a slot: pages unref'd (shared ones survive for their
+        sharers), the request re-queued with prompt+generated as its new
+        prefill prefix (COW re-admission); its pending sampled token is
+        dropped — the resume prefill re-derives it (same prefix, same
+        greedy argmax / same fold_in(seed, position) draw)."""
+        if slot in self._slot_req:
+            req = self._slot_req.pop(slot)
+        else:
+            req = self._prefilling.pop(slot).req
+        self._release_slot(slot)
+        req.preemptions += 1
+        self.preemptions += 1
+        self.scheduler.requeue(req)
+
+    def _release_slot(self, slot: int) -> None:
+        scratch = self.pool.scratch_page
+        for j in range(self.max_pages):
+            if self._tbl[slot, j] != scratch:
+                self.pool.unref(int(self._tbl[slot, j]))
+                self._tbl[slot, j] = scratch
+        self._pos[slot] = 0
+        self._free_slots.append(slot)
+
+    def _alloc_page(self, needy_slot: int) -> int:
+        """A free page, evicting victims if the pool is dry (never the
+        needy slot itself). Submit-time validation guarantees a sole live
+        request fits, so exhaustion with no victim cannot happen."""
+        while True:
+            try:
+                return self.pool.alloc()
+            except PoolExhausted:
+                cands = self._candidates(exclude_slot=needy_slot)
+                if not cands:
+                    raise RuntimeError(
+                        "page pool exhausted with no preemption candidate "
+                        "— a single request outgrew num_pages (submit-time "
+                        "validation should have refused it)")
+                self._preempt(cands[0][0])
+
+    def _ensure_writable(self, slot: int, lo: int, hi: int) -> None:
+        """Positions [lo, hi) of `slot` must land in PRIVATE pages before
+        a write dispatch: unmapped entries allocate, shared entries
+        copy-on-write (one bucketed copy dispatch)."""
+        ps, scratch = self.page_size, self.pool.scratch_page
+        pairs = []
+        for j in range(lo // ps, -(-hi // ps)):
+            pid = int(self._tbl[slot, j])
+            if pid == scratch:
+                self._tbl[slot, j] = self._alloc_page(slot)
+            elif self.pool.refcount[pid] > 1:
+                new = self._alloc_page(slot)
+                pairs.append((pid, new))
+                self.pool.unref(pid)
+                self._tbl[slot, j] = new
+        self.pool.copy_pages(pairs)
+
+    def _pump_prefill(self, done: List[Request]) -> None:
+        """Advance prefills chunk by chunk. While ANY stream is live
+        decoding, at most `prefill_chunk` positions are dispatched per
+        engine step — the bound on how long a decode dispatch can be
+        delayed by prefill work (`max_interleaved_prefill` tracks the
+        realised max; tests assert it)."""
+        interleaved = 0
+        while self._prefilling:
+            live_before = bool(self._slot_req)
+            if live_before and interleaved >= self.prefill_chunk:
+                break
+            slot, st = next(iter(self._prefilling.items()))
+            self._try_share(slot, st)      # COW prefix reuse, page-aligned
+            budget = (self.prefill_chunk - interleaved if live_before
+                      else self.prefill_chunk)
+            n = min(len(st.ids) - st.s, budget)
+            self._dispatch_chunk(slot, st, n, done)
+            if live_before:
+                interleaved += n
+        self.max_interleaved_prefill = max(self.max_interleaved_prefill,
+                                           interleaved)
+
+    def _dispatch_chunk(self, slot: int, st: _PrefillState, n: int,
+                        done: List[Request]) -> None:
+        ps = self.page_size
+        s, ids, req = st.s, st.ids, st.req
+        self._ensure_writable(slot, s, s + n)
+        cw = _pow2_at_most(n, self.prefill_chunk)
+        buf = np.full((1, cw), self.eos_id, np.int32)
+        buf[0, :n] = ids[s:s + n]
+        dstp = np.full((1, cw), self.pool.scratch_page, np.int32)
+        dsto = np.zeros((1, cw), np.int32)
+        for i in range(cw):
+            if i < n:
+                dstp[0, i] = self._tbl[slot, (s + i) // ps]
+                dsto[0, i] = (s + i) % ps
+            else:
+                dsto[0, i] = i % ps
+        if cw not in self._chunk_fns:
+            self._chunk_fns[cw] = self._build_chunk(cw)
+        with self._span("prefill_chunk", slot=slot, pos0=s, n=n, cw=cw):
+            ks, vs, tok = self._chunk_fns[cw](
+                self.params, self.pool.ks, self.pool.vs, jnp.asarray(buf),
+                jnp.asarray([s], np.int32), jnp.asarray([n], np.int32),
+                jnp.asarray(self._tbl[slot:slot + 1]), jnp.asarray(dstp),
+                jnp.asarray(dsto),
+                jnp.asarray([req.seed], np.uint32))
+            self.pool.adopt(ks, vs)
+            tok = np.asarray(tok)
+        self.prefill_positions += n
+        # register freshly completed prompt pages in the prefix index:
+        # full pages whose last position this chunk wrote, and the partial
+        # tail once the whole prefix is in (shared donors dedupe inside
+        # register_prefix)
+        for j in range(s // ps, -(-(s + n) // ps)):
+            end = min((j + 1) * ps, len(ids))
+            if s + n >= end:
+                parent = st.keys[j - 1] if j else None
+                self.pool.register_prefix(parent, int(self._tbl[slot, j]),
+                                          ids[j * ps:end])
+        st.s += n
+        if st.s >= len(ids):
+            self._finish_prefill(slot, st, int(tok[0]), done)
+
+    def _finish_prefill(self, slot: int, st: _PrefillState, first: int,
+                        done: List[Request]) -> None:
+        req = st.req
+        del self._prefilling[slot]
+        now = self._clock()
+        if req.first_token_t is None:
+            req.first_token_t = now
+        if first == self.eos_id:              # 0 (more) generated tokens
+            req.finish_t = now
+            self._release_slot(slot)
+            self._complete(req, done)
+            return
+        self._slot_req[slot] = req
+        self._tokens[slot] = first
+        self._pos[slot] = len(st.ids)
+        self._seeds[slot] = np.uint32(req.seed)
+
+    def _decode(self, done: List[Request]) -> None:
+        # grow/privatise the write page of every live slot FIRST — this
+        # may itself preempt victims (page exhaustion), so iterate a
+        # snapshot and re-check liveness
+        for slot in list(self._slot_req):
+            if slot not in self._slot_req:
+                continue
+            pos = int(self._pos[slot])
+            self._ensure_writable(slot, pos, pos + 1)
+        if not self._slot_req:
+            return
+        with self._span("decode_step", live=len(self._slot_req)):
+            ks, vs, tok = self._step_fn(
+                self.params, self.pool.ks, self.pool.vs,
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                jnp.asarray(self._seeds), jnp.asarray(self._tbl))
+            self.pool.adopt(ks, vs)
+            tok = np.asarray(tok)
+        now = self._clock()
+        self.decode_steps += 1
+        live_tokens = sum(int(self._pos[s]) + 1 for s in self._slot_req)
+        live_tokens += sum(st.s for st in self._prefilling.values())
+        used = self.pool.pages_in_use
+        self._occupancy_sum += self.live_requests / self.num_slots
+        self._pages_used_sum += used
+        if used:
+            self._kv_util_sum += live_tokens / (used * self.page_size)
+        if self.tracer is not None:
+            self.tracer.counter("slots_live", len(self._slot_req))
+            self.tracer.counter("pages_in_use", used)
+        for slot, req in list(self._slot_req.items()):
+            req.tokens.append(int(self._tokens[slot]))
+            self.generated_tokens += 1
+            cand = int(tok[slot])
+            self._pos[slot] += 1
+            if cand == self.eos_id or req.prompt_len + len(req.tokens) >= req.limit:
+                req.finish_t = now
+                del self._slot_req[slot]
+                self._release_slot(slot)
+                self._complete(req, done)
+            else:
+                self._tokens[slot] = cand
+
+    def _complete(self, req: Request, done: List[Request]) -> None:
+        self.completed.append(req)
+        done.append(req)
+        if self.writer is not None:
+            ms = lambda s: None if s is None else round(s * 1e3, 3)
+            self.writer.event(
+                "serve_request", rid=req.rid, prompt_len=req.prompt_len,
+                generated=len(req.tokens), tenant=req.tenant,
+                slo_class=req.slo_class, preemptions=req.preemptions,
+                queue_wait_ms=ms(req.queue_wait_s), ttft_ms=ms(req.ttft_s),
+                tpot_ms=ms(req.tpot_s))
+
+    # -- aggregate view ---------------------------------------------------
+    def stats(self) -> dict:
+        steps = max(self.decode_steps, 1)
+        demand = max(self.prefill_token_demand, 1)
+        return {
+            "decode_steps": self.decode_steps,
+            "generated_tokens": self.generated_tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "completed": len(self.completed),
+            "rejected": self.scheduler.rejected,
+            "slot_occupancy_mean": round(
+                self._occupancy_sum / steps if self.decode_steps else 0.0, 4),
+            "prefill_positions": self.prefill_positions,
+            # -- token-granular occupancy (the paged win, measured) ------
+            "page_size": self.page_size,
+            "num_pages": self.pool.num_pages,
+            "pages_in_use": self.pool.pages_in_use,
+            "pages_in_use_mean": round(self._pages_used_sum / steps
+                                       if self.decode_steps else 0.0, 2),
+            # live tokens / allocated page bytes: 1.0 = no dead space
+            "kv_util_mean": round(
+                self._kv_util_sum / steps if self.decode_steps else 0.0, 4),
+            "kv_fragmentation_mean": round(
+                1.0 - self._kv_util_sum / steps
+                if self.decode_steps else 0.0, 4),
+            # -- COW prefix cache ----------------------------------------
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_hit_rate": round(self.prefix_hit_tokens / demand, 4)
+            if self.prefill_token_demand else 0.0,
+            "cow_copies": self.pool.cow_copies,
+            # -- scheduler/preemption ------------------------------------
+            "preemptions": self.preemptions,
+            "max_live": self.max_live,
+            "max_interleaved_prefill_positions": self.max_interleaved_prefill,
         }

@@ -34,6 +34,7 @@ from typing import List
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import resolve_dtype
@@ -101,4 +102,183 @@ class KVCachePool:
         """Swap in the pool arrays a donating jitted program returned (the
         old handles were consumed by donation — holding on to them would
         raise on next use)."""
+        self.ks, self.vs = ks, vs
+
+
+class PoolExhausted(RuntimeError):
+    """Raised by PagedKVPool.alloc when no free page exists — the paged
+    engine's signal to preempt a victim (or refuse admission)."""
+
+
+class PagedKVPool:
+    """Fixed-size KV PAGES + host-side free list, refcounts, and a
+    content-addressed prefix index (serving v2, ISSUE 6).
+
+    The slot pool above leases one `buf_len`-long cache row per request —
+    HBM cost is `slots x buf_len` whatever the actual lengths. Here the
+    unit is a PAGE of `page_size` token positions,
+
+        (num_layers, num_pages + 1, local_kv_heads, page_size, head_dim)
+
+    sharded over 'tp' on heads like everything else, and a request's
+    logical cache row is a per-slot PAGE LIST (the engine's
+    `(slots, max_pages)` page-table array). Pages are leased on demand as
+    a request's cursor grows, so concurrency is bounded by live TOKENS,
+    not worst-case rows — and identical prompt prefixes can SHARE pages:
+
+    * refcount[p] = number of slot page-lists referencing page p. alloc()
+      hands a free page at refcount 1; ref() adds a sharer; unref() drops
+      one and returns the page to the free list (and drops its prefix-
+      index entries) at zero — after every request retires the counts
+      drain to zero, pinned in tests.
+    * copy-on-write: a WRITER whose target page has refcount > 1 must
+      materialise a private copy first (`copy_pages`, one bucketed device
+      dispatch per engine step) — sharers keep the original bits.
+    * prefix index: prompt pages register under a hash CHAIN key
+      (key_j = (key_{j-1}, page_tokens)) with their valid tokens
+      alongside, so a new prompt WALKS the chain page by page and may
+      finish on a partial match inside the last candidate (visibility
+      masks the rest). Content at a position is never mutated once written (writes
+      only append; COW protects shared pages), so an indexed page stays
+      valid until freed. Index entries hold NO refcount — sharing only
+      happens against pages some live request still references, which is
+      what lets the drain-to-zero invariant hold.
+
+    The LAST page (index num_pages) is scratch: free slots' page tables
+    and chunk-pad columns aim their writes at it, and nothing ever
+    attends to it (the same quarantine trick as the slot pool's scratch
+    row)."""
+
+    def __init__(self, model, mesh: Mesh, num_pages: int, page_size: int):
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        cfg = model.cfg
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.scratch_page = num_pages          # never leased; pad target
+        self.dtype = resolve_dtype(cfg.compute_dtype)
+        shape = (cfg.num_layers, num_pages + 1, cfg.kv_heads, page_size,
+                 cfg.head_dim)
+        self._sharding = NamedSharding(mesh, POOL_SPEC)
+        alloc = jax.jit(lambda: jnp.zeros(shape, self.dtype),
+                        out_shardings=self._sharding)
+        self.ks = alloc()
+        self.vs = alloc()
+        self._free = deque(range(num_pages))
+        self.refcount = np.zeros(num_pages, np.int32)
+        # content-addressed prefix index (see class docstring)
+        self._children = {}     # chain_key -> [(page_id, tokens_tuple)]
+        self._page_keys = {}    # page_id -> parent chain_key (for dereg)
+        self.cow_copies = 0
+        self._copy_fns = {}
+
+    # -- page leasing -----------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise PoolExhausted(
+                f"page pool exhausted ({self.num_pages} pages leased) — "
+                f"the engine preempts or the scheduler gates admission")
+        page = self._free.popleft()
+        self.refcount[page] = 1
+        return page
+
+    def ref(self, page: int) -> None:
+        assert self.refcount[page] > 0, f"ref of free page {page}"
+        self.refcount[page] += 1
+
+    def unref(self, page: int) -> None:
+        if not 0 <= page < self.num_pages:
+            raise ValueError(f"page {page} out of range [0, {self.num_pages})")
+        if self.refcount[page] <= 0:
+            raise ValueError(f"page {page} unref'd below zero")
+        self.refcount[page] -= 1
+        if self.refcount[page] == 0:
+            self._deregister(page)
+            self._free.append(page)
+
+    # -- prefix index -----------------------------------------------------
+    @staticmethod
+    def chain_key(parent, tokens) -> tuple:
+        """Content key of a page-aligned token run: the tokens chained onto
+        the key of everything before them (two pages with identical tokens
+        under DIFFERENT prefixes must not collide — K/V depend on the whole
+        prefix)."""
+        return (parent, tuple(int(t) for t in tokens))
+
+    def register_prefix(self, parent, page: int, tokens) -> None:
+        """Index a prompt page under its prefix chain. `tokens` are the
+        page's VALID positions — `page_size` of them for a full page (the
+        walk may continue past it), fewer for a partial prompt tail (later
+        decode writes into the page land beyond the valid run and sharers
+        mask them). A page already indexed (a shared donor re-announced by
+        a sharer) is skipped — a missed duplicate is only a missed future
+        share, never an error."""
+        if page in self._page_keys:
+            return
+        tokens = tuple(int(t) for t in tokens)
+        self._children.setdefault(parent, []).append((page, tokens))
+        self._page_keys[page] = parent
+
+    def children(self, parent):
+        """Candidate next pages under a prefix chain: [(page, tokens)].
+        A candidate matching only k < len(tokens) leading tokens is still
+        shareable up to k — visibility masks the rest."""
+        return self._children.get(parent, [])
+
+    def _deregister(self, page: int) -> None:
+        # the chain ROOT's parent key is None, so None cannot double as
+        # the "not indexed" sentinel here
+        if page not in self._page_keys:
+            return
+        parent = self._page_keys.pop(page)
+        lst = [e for e in self._children.get(parent, []) if e[0] != page]
+        if lst:
+            self._children[parent] = lst
+        else:
+            self._children.pop(parent, None)
+
+    # -- copy-on-write ----------------------------------------------------
+    def _build_copy(self, m: int):
+        sh = self._sharding
+
+        def fn(pk, pv, src, dst):
+            return (pk.at[:, dst].set(pk[:, src]),
+                    pv.at[:, dst].set(pv[:, src]))
+
+        return jax.jit(fn, donate_argnums=(0, 1),
+                       out_shardings=(sh, sh))
+
+    def copy_pages(self, pairs) -> None:
+        """Materialise private copies: pairs of (src_page, dst_page), one
+        device dispatch (padded to a pow2 bucket with harmless
+        scratch->scratch self-copies so the jit variant count stays
+        logarithmic)."""
+        if not pairs:
+            return
+        m = 1
+        while m < len(pairs):
+            m *= 2
+        src = np.full(m, self.scratch_page, np.int32)
+        dst = np.full(m, self.scratch_page, np.int32)
+        for i, (s, d) in enumerate(pairs):
+            src[i], dst[i] = s, d
+        if m not in self._copy_fns:
+            self._copy_fns[m] = self._build_copy(m)
+        ks, vs = self._copy_fns[m](self.ks, self.vs, jnp.asarray(src),
+                                   jnp.asarray(dst))
+        self.adopt(ks, vs)
+        self.cow_copies += len(pairs)
+
+    # -- device-array handoff ---------------------------------------------
+    def adopt(self, ks, vs) -> None:
         self.ks, self.vs = ks, vs

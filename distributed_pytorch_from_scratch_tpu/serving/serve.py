@@ -76,6 +76,43 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     g.add_argument("--decode_top_k", type=int, default=0)
     g.add_argument("--decode_top_p", type=float, default=0.0)
 
+    g = p.add_argument_group("paged engine (serving v2)")
+    g.add_argument("--paged", action="store_true",
+                   help="serve through the PAGED engine: page-table KV "
+                        "cache with COW prefix reuse, chunked prefill, and "
+                        "the SLO-aware scheduler (docs/SERVING.md v2)")
+    g.add_argument("--page_size", type=int, default=64,
+                   help="--paged: tokens per KV page")
+    g.add_argument("--num_pages", type=int, default=0,
+                   help="--paged: page-pool HBM budget in pages (0 = "
+                        "slots x ceil(buf_len/page_size), i.e. no "
+                        "oversubscription — raise slots past the pool to "
+                        "oversubscribe)")
+    g.add_argument("--prefill_chunk", type=int, default=128,
+                   help="--paged: prefill positions per chunk; a live "
+                        "stream's decode never stalls by more than one "
+                        "chunk")
+    g.add_argument("--slo_classes", default="interactive=0.25,standard=1.0,"
+                                            "batch=8.0",
+                   help="--paged: TTFT deadline classes, name=seconds "
+                        "pairs (scheduler.parse_slo_classes)")
+    g.add_argument("--default_class", default="standard",
+                   help="--paged: class for requests that name none")
+    g.add_argument("--class_mix", default="",
+                   help="loadgen: draw request classes by weight, e.g. "
+                        "'interactive=1,batch=1' (empty = default class)")
+    g.add_argument("--tenants", type=int, default=1,
+                   help="loadgen: spread requests over N tenants "
+                        "(the fair-queuing axis)")
+    g.add_argument("--shared_prefix_len", type=int, default=0,
+                   help="loadgen: prepend one common random prefix of N "
+                        "tokens to every prompt (system-prompt stand-in; "
+                        "feeds the COW prefix cache)")
+    g.add_argument("--interleave", action="store_true",
+                   help="loadgen: alternate short/long prompts "
+                        "(prompt_len_min / prompt_len_max) instead of "
+                        "uniform lengths — the head-of-line stress")
+
     g = p.add_argument_group("loadgen")
     g.add_argument("--num_requests", type=int, default=32)
     g.add_argument("--rate", type=float, default=4.0,
@@ -99,6 +136,17 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     args = p.parse_args(argv)
     if (args.decode_top_k or args.decode_top_p) and not args.temperature:
         p.error("--decode_top_k/--decode_top_p need --temperature > 0")
+    # class/tenant mixes and the page budget only matter to the paged
+    # engine; a silent no-op would misreport what the run measured
+    if not args.paged:
+        if args.num_pages:
+            p.error("--num_pages is a --paged knob")
+        if args.class_mix:
+            p.error("--class_mix needs --paged (the FIFO engine has no "
+                    "SLO classes)")
+        if args.tenants != 1:
+            p.error("--tenants needs --paged (the FIFO engine ignores "
+                    "tenants — the run would measure nothing fair)")
     if args.arrival == "replay" and not args.replay and not args.dry_run:
         p.error("--arrival replay needs --replay PATH")
     if not args.dry_run and not args.random_init and not args.ckpt_dir:
@@ -153,6 +201,12 @@ def serve(args: argparse.Namespace) -> dict:
         args.prompt_len_min, args.prompt_len_max = 4, 12
         args.max_new_tokens = min(args.max_new_tokens, 8)
         args.buf_len, args.prefill_bucket = 24, 8
+        if args.paged:       # tiny pages so the smoke crosses boundaries
+            args.page_size, args.prefill_chunk = 8, 8
+            args.num_pages = 0
+            if not args.class_mix:
+                args.class_mix = "interactive=1,standard=1"
+            args.shared_prefix_len = max(args.shared_prefix_len, 4)
     else:
         cfg = build_model_config(args, vocab_size)
 
@@ -168,10 +222,15 @@ def serve(args: argparse.Namespace) -> dict:
     if args.arrival == "replay" and args.replay:
         requests = replay_requests(args.replay)
     else:
+        from .scheduler import parse_slo_classes
+        mix = parse_slo_classes(args.class_mix) if args.class_mix else None
         requests = synthetic_requests(
             args.num_requests, args.prompt_len_min, args.prompt_len_max,
             args.max_new_tokens, vocab_size, seed=args.seed,
-            rate=args.rate, arrival=args.arrival)
+            rate=args.rate, arrival=args.arrival, class_mix=mix,
+            tenants=args.tenants,
+            shared_prefix_len=args.shared_prefix_len,
+            interleave=args.interleave)
     longest = max(len(r.prompt) for r in requests)
     buf_len = args.buf_len or (longest + args.max_new_tokens + 2)
     cap = getattr(model, "max_decode_positions", None)
@@ -186,13 +245,27 @@ def serve(args: argparse.Namespace) -> dict:
     tracer = SpanTracer(args.log_dir, process_name="serve")
     writer = MetricsWriter(args.log_dir, process_index=0)
     try:
-        engine = ContinuousBatchingEngine(
-            model, mesh, params, num_slots=args.slots, buf_len=buf_len,
-            eos_id=eos_id, temperature=args.temperature,
-            top_k=args.decode_top_k, top_p=args.decode_top_p,
-            prefill_bucket=args.prefill_bucket,
-            max_prefill_batch=args.max_prefill_batch,
-            max_queue=args.queue_limit, tracer=tracer, writer=writer)
+        if args.paged:
+            from .engine import PagedEngine
+            from .scheduler import parse_slo_classes
+            engine = PagedEngine(
+                model, mesh, params, num_slots=args.slots, buf_len=buf_len,
+                eos_id=eos_id, page_size=args.page_size,
+                num_pages=args.num_pages,
+                prefill_chunk=args.prefill_chunk,
+                temperature=args.temperature, top_k=args.decode_top_k,
+                top_p=args.decode_top_p,
+                slo_classes=parse_slo_classes(args.slo_classes),
+                default_class=args.default_class,
+                max_queue=args.queue_limit, tracer=tracer, writer=writer)
+        else:
+            engine = ContinuousBatchingEngine(
+                model, mesh, params, num_slots=args.slots, buf_len=buf_len,
+                eos_id=eos_id, temperature=args.temperature,
+                top_k=args.decode_top_k, top_p=args.decode_top_p,
+                prefill_bucket=args.prefill_bucket,
+                max_prefill_batch=args.max_prefill_batch,
+                max_queue=args.queue_limit, tracer=tracer, writer=writer)
         summary = run_loadgen(engine, requests)
     finally:
         path = tracer.close()
@@ -211,10 +284,15 @@ def serve(args: argparse.Namespace) -> dict:
           + (f"; pad waste eliminated "
              f"{100 * summary['prefill_pad_waste_eliminated']:.0f}%"
              if summary["prefill_pad_waste_eliminated"] > 0 else "")
+          + (f"; kv util {summary['kv_util_mean']:.2f}, prefix hits "
+             f"{100 * summary['prefix_hit_rate']:.0f}%, "
+             f"{summary['preemptions']} preempted"
+             if "kv_util_mean" in summary else "")
           + (f"; trace {path}" if path else ""), file=sys.stderr)
-    print(json.dumps({
+    rec = {
         "metric": (f"serving tokens/sec ({args.family}, tp={args.tp_size}, "
-                   f"slots={args.slots}, {args.arrival} arrivals"
+                   + ("paged, " if args.paged else "")
+                   + f"slots={args.slots}, {args.arrival} arrivals"
                    + (f" @{args.rate:g}/s" if args.arrival == "poisson"
                       else "") + ")"),
         "value": summary["tokens_per_sec"],
@@ -224,7 +302,13 @@ def serve(args: argparse.Namespace) -> dict:
             "slot_occupancy_mean", "ttft_ms_p50", "ttft_ms_p95",
             "tpot_ms_p50", "tpot_ms_p95", "queue_wait_ms_p50",
             "queue_wait_ms_p95", "prefill_pad_waste_eliminated")},
-    }))
+    }
+    for k in ("kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
+              "cow_copies", "preemptions", "max_live",
+              "max_interleaved_prefill_positions", "slo_attainment"):
+        if k in summary:
+            rec[k] = summary[k]
+    print(json.dumps(rec))
     return summary
 
 

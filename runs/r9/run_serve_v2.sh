@@ -1,0 +1,50 @@
+#!/bin/bash
+# Round-9 serving-v2 session (ISSUE 6): the PAGED engine under load on the
+# 45m shape. Order: a paged rate sweep (poisson at a light and a saturating
+# rate, shared prefix + class mix + tenants so the COW cache and the SLO
+# scheduler both see real work), then the head-of-line stress (long/short
+# interleave burst, slot engine vs paged engine at the SAME page-pool HBM
+# budget — 8 slots x 386-token rows = 3088 tokens, floored to 48 x 64-token
+# pages, paged oversubscribed to 16 slots), then the bench A/B line (vs_baseline = continuous-batching
+# speedup, paged_vs_slot = the v2 capacity/latency win). Each run writes
+# its own obs dir so serving_summary + paged_kv_stats events and the
+# Chrome traces stay separable; summarize_run.py renders the SLO
+# attainment / kv util / prefix-hit lines at the end.
+# Weights are random inits (--random_init): serving latency/throughput
+# depend on shapes, not values, so no checkpoint transfer burns window.
+# Idempotent; reuses the round-5 session helpers (step/bench_line artifact
+# guards, SESSION_DEADLINE chokepoint via scripts/run_step.py).
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r9
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r9 serving-v2 pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 1. paged rate sweep: open-loop poisson at a light and a saturating rate.
+#    64-token shared prefix (the COW cache's food), interactive/batch mix
+#    over 4 tenants (the SLO scheduler's food). Same request distribution
+#    at both rates, so the TTFT/queue-wait/attainment deltas isolate
+#    queueing + preemption behaviour.
+step paged_rate2 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --num_requests 64 --rate 2 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --shared_prefix_len 64 --class_mix interactive=1,batch=1 --tenants 4 --log_dir runs/r9/paged_rate2
+step paged_rate8 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --num_requests 64 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --shared_prefix_len 64 --class_mix interactive=1,batch=1 --tenants 4 --log_dir runs/r9/paged_rate8
+
+# 2. the head-of-line stress: long/short interleave burst, slot engine vs
+#    paged engine at the SAME HBM budget. The slot run is the PR 5 engine
+#    (8 rows pre-carved); the paged run spends the identical bytes as 48
+#    pages with 16 oversubscribed slots and chunked prefill — the short
+#    requests' TTFT p95 and the queue-wait tail are the comparison.
+step interleave_slot 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --slots 8 --num_requests 64 --arrival burst --interleave --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --prefill_bucket 128 --log_dir runs/r9/interleave_slot
+step interleave_paged 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --num_requests 64 --arrival burst --interleave --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --shared_prefix_len 64 --class_mix interactive=1,batch=1 --tenants 4 --log_dir runs/r9/interleave_paged
+
+# 3. the headline A/B line: one-shot GreedyDecoder vs slot engine vs paged
+#    engine on the same long/short request set at equal HBM
+#    (vs_baseline = continuous batching; paged_vs_slot = serving v2)
+bench_line 45mpaged 1200 --serving --model 45m --tp 1 --slots 8 --serve_requests 32 --prompt_len 128 --gen_tokens 128 --page_size 64 --prefill_chunk 128
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r9 serving-v2 done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

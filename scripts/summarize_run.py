@@ -36,6 +36,13 @@ def bench_lines(rdir):
             detail = (f"TTFT p50/p95 {rec.get('ttft_ms_p50')}/"
                       f"{rec.get('ttft_ms_p95')}ms"
                       + (f", occupancy {occ}" if occ is not None else ""))
+            if rec.get("paged_vs_slot") is not None:
+                # serving-v2 A/B line: paged vs the slot engine at equal HBM
+                detail += (f", x{rec['paged_vs_slot']} vs slot engine, "
+                           f"max live {rec.get('max_live')}, kv util "
+                           f"{rec.get('kv_util_mean')}, prefix hits "
+                           f"{rec.get('prefix_hit_rate')}, "
+                           f"{rec.get('preemptions')} preempted")
             rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
                         f"| x{rec.get('vs_baseline')} vs one-shot decode "
                         f"| {detail} |")
@@ -145,9 +152,25 @@ def serving_lines(rdir):
                 rec = json.loads(line)
             except ValueError:
                 continue
+            if rec.get("tag") == "paged_kv_stats":
+                # token-granular page economics (the serving-v2 engine)
+                rows.append(
+                    f"- `{rel}` pages: {rec.get('pages_in_use_mean')} of "
+                    f"{rec.get('num_pages')} x{rec.get('page_size')}-token "
+                    f"pages in use (mean), kv util "
+                    f"{rec.get('kv_util_mean')} (frag "
+                    f"{rec.get('kv_fragmentation_mean')}), prefix hit rate "
+                    f"{rec.get('prefix_hit_rate')} "
+                    f"({rec.get('prefix_hit_tokens')} tokens), "
+                    f"{rec.get('cow_copies')} COW copies, "
+                    f"{rec.get('preemptions')} preemptions, max live "
+                    f"{rec.get('max_live')}, max interleaved prefill "
+                    f"{rec.get('max_interleaved_prefill_positions')} "
+                    f"positions/step")
+                continue
             if rec.get("tag") != "serving_summary":
                 continue
-            rows.append(
+            line = (
                 f"- `{rel}`: {rec.get('completed')}/{rec.get('requests')} "
                 f"requests ({rec.get('rejected', 0)} rejected) in "
                 f"{rec.get('wall_s', 0):.1f}s — "
@@ -158,6 +181,18 @@ def serving_lines(rdir):
                 f"{ms(rec, 'tpot_ms_p95')}ms, queue p50/p95 "
                 f"{ms(rec, 'queue_wait_ms_p50')}/"
                 f"{ms(rec, 'queue_wait_ms_p95')}ms")
+            att = rec.get("slo_attainment")
+            if att:
+                # per-deadline-class TTFT attainment (serving v2)
+                line += "; SLO " + ", ".join(
+                    f"{name} {100 * c.get('attained', 0):.0f}% of "
+                    f"{c.get('completed')} (<= {c.get('deadline_s')}s)"
+                    for name, c in sorted(att.items()))
+            if "kv_util_mean" in rec:
+                line += (f"; kv util {rec['kv_util_mean']}, prefix hits "
+                         f"{rec.get('prefix_hit_rate')}, "
+                         f"{rec.get('preemptions')} preempted")
+            rows.append(line)
     return rows
 
 

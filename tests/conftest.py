@@ -75,6 +75,18 @@ CORE_LANE = {
                         "test_scheduler_fifo_bucket_groups",
                         "test_scheduler_backpressure_and_validation",
                         "test_serve_dry_run_smoke"],
+    # serving v2 (paged): the paged-vs-slot-vs-greedy identity anchor at
+    # tp=2, COW sharing + refcount drain, the chunked-prefill stall bound,
+    # the equal-HBM capacity win (both ISSUE 6 acceptance criteria), the
+    # pure-host SLO scheduler laws, and the --paged CLI rot guard
+    "test_serving_paged.py": [
+        "test_paged_matches_slot_and_greedy[2-8]",
+        "test_cow_shared_prefix_identity_and_drain",
+        "test_chunked_vs_whole_prefill_identity_and_stall_bound",
+        "test_capacity_win_at_equal_hbm",
+        "test_slo_scheduler_class_ordering_and_fairness",
+        "test_paged_serve_dry_run_smoke",
+    ],
     "test_sequence_parallel.py": ["test_model_sp_matches_vanilla[1-1-4]"],
     "test_overlap.py": ["test_ag_matmul_matches_gather_dot_oracle[1-2]",
                         "test_matmul_rs_matches_dot_scatter_oracle[2]",
