@@ -164,9 +164,20 @@ def parse_args(argv=None):
     p.add_argument("--prefill_chunk", type=int, default=128,
                    help="--serving: paged-engine prefill chunk (positions "
                         "per dispatch interleaved into the decode loop)")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="--serving: add a SPECULATIVE arm to the A/B — a "
+                        "'tiny'-preset drafter proposes K tokens per round, "
+                        "the target verifies them in one dispatch "
+                        "(serving/speculative.py). Equal-HBM: the drafter's "
+                        "pages are paid for by SHRINKING the target page "
+                        "pool below the slot engine's budget. The record "
+                        "gains vs_paged (speedup over the non-speculative "
+                        "paged arm) + accepted_tokens_per_dispatch")
     args = p.parse_args(argv)
     if args.serving and (args.decode or args.breakdown):
         p.error("--serving excludes --decode/--breakdown")
+    if args.speculate and not args.serving:
+        p.error("--speculate is a --serving mode")
     if args.remat is None:
         args.remat = "dots" if args.model == "gpt2-355m" else "false"
     if args.analytic and not args.breakdown:
@@ -404,6 +415,49 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     paged_summary = run_loadgen(paged, burst())
     paged_rate = paged_summary["tokens_per_sec"]
 
+    # (a') the speculative arm at the SAME byte budget: the drafter's pages
+    # buy acceptance, not capacity, so they are paid for by SHRINKING the
+    # target pool — budget_bytes = slots x buf_len target-token bytes,
+    # minus the drafter pool's bytes, floored to target pages. (Clamped so
+    # one worst-case request still fits each pool.)
+    spec_summary = None
+    spec_pages = {}
+    if args.speculate:
+        from distributed_pytorch_from_scratch_tpu.config import model_preset
+        from distributed_pytorch_from_scratch_tpu.models.transformer import (
+            Transformer as _LlamaTransformer)
+        from distributed_pytorch_from_scratch_tpu.serving.kv_manager import (
+            kv_token_bytes, page_bytes)
+        from distributed_pytorch_from_scratch_tpu.serving.speculative import (
+            SpeculativeEngine)
+
+        # drafter: the 'tiny' preset at the target's vocab. Always the
+        # RoPE llama family — no learned-position cap to fight, and the
+        # verify step only needs a shared vocabulary, not a shared family.
+        dcfg = model_preset("tiny", vocab_size=cfg.vocab_size,
+                            maxlen=cfg.maxlen,
+                            compute_dtype=cfg.compute_dtype)
+        dmodel = _LlamaTransformer(dcfg, tp_size=tp)
+        dparams = jax.device_put(dmodel.init(jax.random.key(3)),
+                                 dmodel.shardings(mesh))
+        k = args.speculate
+        ps = args.page_size
+        d_max_pages = -(-(buf_len + k + 1) // ps)
+        d_pages = args.serve_requests * d_max_pages
+        budget_bytes = args.slots * buf_len * kv_token_bytes(cfg)
+        d_bytes = d_pages * page_bytes(dcfg, ps)
+        t_pages = max(-(-buf_len // ps),
+                      int((budget_bytes - d_bytes) // page_bytes(cfg, ps)))
+        spec_pages = {"target_pages": t_pages, "drafter_pages": d_pages,
+                      "drafter_budget_share": round(
+                          d_bytes / max(budget_bytes, 1), 4)}
+        spec = SpeculativeEngine(
+            model, mesh, params, dmodel, dparams,
+            num_slots=args.serve_requests, buf_len=buf_len, eos_id=eos,
+            speculate_k=k, drafter_pages=d_pages, page_size=ps,
+            num_pages=t_pages, prefill_chunk=args.prefill_chunk)
+        spec_summary = run_loadgen(spec, burst())
+
     # (b) the PR 5 slot engine
     engine = ContinuousBatchingEngine(
         model, mesh, params, num_slots=args.slots, buf_len=buf_len,
@@ -430,6 +484,18 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     oneshot_rate = oneshot_tokens / max(oneshot_s, 1e-9)
 
     fmt = lambda v: "-" if v is None else f"{v:.0f}"
+    spec_line = ""
+    if spec_summary is not None:
+        spec_line = (
+            f" vs SPECULATIVE k={args.speculate} "
+            f"{spec_summary['tokens_per_sec']:.0f} tok/s "
+            f"({spec_summary['accepted_tokens_per_dispatch']:.2f} "
+            f"tok/dispatch, acceptance "
+            f"{100 * spec_summary['acceptance_rate']:.0f}%, "
+            f"{spec_pages['target_pages']}+{spec_pages['drafter_pages']} "
+            f"target+drafter pages = "
+            f"{100 * spec_pages['drafter_budget_share']:.1f}% of budget "
+            f"on the drafter)")
     print(f"bench[serving {args.model} {args.family}]: "
           f"{args.serve_requests}-request long/short interleave — paged "
           f"{paged_rate:.0f} tok/s (TTFT p95 "
@@ -437,26 +503,56 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
           f"{paged_summary['max_live']}, kv util "
           f"{paged_summary['kv_util_mean']:.2f}, prefix hits "
           f"{100 * paged_summary['prefix_hit_rate']:.0f}%, "
-          f"{paged_summary['preemptions']} preempted) vs slot "
+          f"{paged_summary['preemptions']} preempted)" + spec_line +
+          f" vs slot "
           f"{serve_rate:.0f} tok/s (TTFT p95 "
           f"{fmt(summary['ttft_ms_p95'])}ms, {args.slots} slots) vs "
           f"one-shot {oneshot_rate:.0f} tok/s "
           f"({oneshot_tokens} tokens in {oneshot_s*1000:.0f}ms); equal "
           f"HBM budget: {num_pages} pages x {args.page_size} = "
           f"{args.slots} slots x {buf_len}", file=sys.stderr)
+    rec_value = paged_rate
+    spec_rec = {}
+    if spec_summary is not None:
+        # the speculative arm is the headline when requested; vs_paged is
+        # ITS A/B (the non-speculative paged engine at equal HBM)
+        rec_value = spec_summary["tokens_per_sec"]
+        spec_rec = {
+            "vs_paged": round(spec_summary["tokens_per_sec"]
+                              / max(paged_rate, 1e-9), 3),
+            "speculate_k": args.speculate,
+            "accepted_tokens_per_dispatch":
+                spec_summary["accepted_tokens_per_dispatch"],
+            "acceptance_rate": spec_summary["acceptance_rate"],
+            "acceptance_rate_by_position":
+                spec_summary["acceptance_rate_by_position"],
+            "spec_rounds": spec_summary["spec_rounds"],
+            "spec_ttft_ms_p95": spec_summary["ttft_ms_p95"],
+            "spec_tpot_ms_p95": spec_summary["tpot_ms_p95"],
+            "drafter_ms_total": spec_summary["drafter_ms_total"],
+            "target_ms_total": spec_summary["target_ms_total"],
+            **spec_pages,
+        }
     print(json.dumps({
         "metric": (f"serving tokens/sec ({args.model} {args.family}, "
-                   f"PAGED at {num_pages}x{args.page_size}-token pages = "
+                   + (f"SPECULATIVE k={args.speculate} (tiny drafter, "
+                      f"drafter pages inside the budget) over "
+                      if args.speculate else "")
+                   + f"PAGED at {num_pages}x{args.page_size}-token pages = "
                    f"slots{args.slots} HBM, {args.serve_requests}-request "
                    f"long/short burst, prompt {max(3, plen // 4)}/{plen}, "
                    f"gen {gen}; vs_baseline = speedup over one-shot "
                    f"b{args.slots} GreedyDecoder batches; paged_vs_slot = "
-                   f"A/B against the slot engine at equal HBM)"),
-        "value": round(paged_rate, 1),
+                   f"A/B against the slot engine at equal HBM"
+                   + ("; vs_paged = speculative / plain paged"
+                      if args.speculate else "") + ")"),
+        "value": round(rec_value, 1),
         "unit": "tokens/sec (serving)",
-        "vs_baseline": round(paged_rate / max(oneshot_rate, 1e-9), 3),
+        "vs_baseline": round(rec_value / max(oneshot_rate, 1e-9), 3),
         "paged_vs_slot": round(paged_rate / max(serve_rate, 1e-9), 3),
+        "paged_rate": round(paged_rate, 1),
         "oneshot_rate": round(oneshot_rate, 1),
+        **spec_rec,
         "ttft_ms_p50": paged_summary["ttft_ms_p50"],
         "ttft_ms_p95": paged_summary["ttft_ms_p95"],
         "tpot_ms_p50": paged_summary["tpot_ms_p50"],

@@ -106,25 +106,33 @@ def _finish_block(model: Transformer, lp: Params, x: jax.Array,
     return x + m["down_proj"].apply(lp["down_proj"], jax.nn.silu(g) * u, dtype)
 
 
-def _logits_last(model: Transformer, params: Params, x_last: jax.Array,
-                 dtype) -> jax.Array:
-    """Final norm + head on (b, 1, d); returns the LOCAL vocab shard
-    (b, vocab_padded/tp) with padded columns masked (mirrors forward_shard).
-    Families without an lm_head module tie the head to the vocab-parallel
-    token embedding (gpt2) — same local-logits layout either way."""
-    x = model.final_norm.apply(params["norm"], x_last)
+def _logits_tokens(model: Transformer, params: Params, x: jax.Array,
+                   dtype) -> jax.Array:
+    """Final norm + head on (b, t, d); returns the LOCAL vocab shard
+    (b, t, vocab_padded/tp) with padded columns masked (mirrors
+    forward_shard). Families without an lm_head module tie the head to the
+    vocab-parallel token embedding (gpt2) — same local-logits layout either
+    way. t = 1 is the single-position decode step; the speculative verify
+    step asks for all k+1 positions at once."""
+    x = model.final_norm.apply(params["norm"], x)
     if hasattr(model, "lm_head"):
-        logits = model.lm_head.apply(params["lm_head"], x, dtype)[:, 0, :]
+        logits = model.lm_head.apply(params["lm_head"], x, dtype)
     else:
         w = params["embedding"]["weight"].astype(dtype)   # (vp/tp, d)
-        logits = (x.astype(dtype) @ w.T)[:, 0, :]
+        logits = x.astype(dtype) @ w.T
     if model.vocab_padded != model.cfg.vocab_size:
         local_v = logits.shape[-1]
         start = lax.axis_index("tp") * local_v
         col = start + jnp.arange(local_v)
-        logits = jnp.where(col[None, :] < model.cfg.vocab_size, logits,
+        logits = jnp.where(col[None, None, :] < model.cfg.vocab_size, logits,
                            jnp.asarray(NEG_INF, logits.dtype))
     return logits
+
+
+def _logits_last(model: Transformer, params: Params, x_last: jax.Array,
+                 dtype) -> jax.Array:
+    """`_logits_tokens` at t = 1: (b, 1, d) -> (b, vocab_padded/tp)."""
+    return _logits_tokens(model, params, x_last, dtype)[:, 0, :]
 
 
 def _prefill(model: Transformer, params: Params, buf: jax.Array,
@@ -380,7 +388,8 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
                          chunk: jax.Array, start: jax.Array,
                          qlen: jax.Array, page_tbl: jax.Array,
                          dst_page: jax.Array, dst_off: jax.Array,
-                         page_size: int, cos_t, sin_t, dtype):
+                         page_size: int, cos_t, sin_t, dtype,
+                         all_logits: bool = False):
     """One CHUNK of an incremental prefill: process `chunk` (b, cw) tokens
     occupying absolute positions start..start+qlen-1 (columns >= qlen are
     pad), write their K/V into the pages `dst_page`/`dst_off` (b, cw) map
@@ -394,7 +403,15 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
     This is `_paged_decode_one` generalised from 1 query to cw queries:
     position p's activations depend only on positions <= p (causality), so
     chunk-at-a-time prefill is value-identical to the whole-buffer
-    `_prefill` — chunking changes cost and stall, never tokens."""
+    `_prefill` — chunking changes cost and stall, never tokens.
+
+    `all_logits=True` (build-time) returns the logits at EVERY chunk
+    position (b, cw, local_v) instead of only the last — the speculative
+    VERIFY step (serving/speculative.py): the target model scores all k+1
+    draft positions in this one dispatch, each row starting at its own
+    cursor (`start` is per-row), with page growth/COW already resolved by
+    the host through the same `dst_page`/`dst_off` maps a prefill chunk
+    uses."""
     b, cw = chunk.shape
     mp = page_tbl.shape[1]
     buf_len = mp * page_size
@@ -440,6 +457,8 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
         return x, (k_cache, v_cache)
 
     x, (k_new, v_new) = lax.scan(body, x, (params["layers"], pool_k, pool_v))
+    if all_logits:
+        return k_new, v_new, _logits_tokens(model, params, x, dtype)
     last = jnp.take_along_axis(
         x, jnp.maximum(qlen - 1, 0)[:, None, None].astype(jnp.int32), axis=1)
     return k_new, v_new, _logits_last(model, params, last, dtype)
@@ -458,10 +477,12 @@ def validate_sampling(cfg, temperature: float, top_k: int,
 
 
 def _full_vocab_logits(model: Transformer, logits: jax.Array) -> jax.Array:
-    """Local vocab-shard logits -> full (b, vocab_size) f32 logits (gathers
-    the tp shards; every shard holds the same values afterwards)."""
+    """Local vocab-shard logits -> full (..., vocab_size) f32 logits
+    (gathers the tp shards along the LAST dim; every shard holds the same
+    values afterwards). Works on the (b, local_v) single-position case and
+    the verify step's (b, k+1, local_v) block alike."""
     full = gather_from(logits.astype(jnp.float32), "tp")
-    return full[:, : model.cfg.vocab_size]
+    return full[..., : model.cfg.vocab_size]
 
 
 def _filter_logits(scaled: jax.Array, top_k: int, top_p: float) -> jax.Array:
@@ -525,6 +546,41 @@ def make_token_sampler(model: Transformer, temperature: float = 0.0,
         return lax.pmax(idx, "tp")
 
     return sample
+
+
+def host_sample_tokens(model: Transformer, padded_logits, seeds, positions,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 0.0):
+    """DEBUG-ONLY host-side sampler over materialised full-vocab logits —
+    the path the engines deliberately do NOT ship (in-program sampling via
+    `make_token_sampler` has been the only production path since PR 5),
+    reachable behind their `debug_host_sampler` flag so the equivalence
+    tests can pin that the fused sampler draws the SAME tokens, and so the
+    r10 ablation can price the per-step full-vocab host transfer the fused
+    design avoids.
+
+    `padded_logits` is the host copy of the tp-concatenated (b,
+    vocab_padded) logits a debug step program returns; the filter/argmax/
+    fold_in(seed, position) schedule mirrors `make_token_sampler` exactly,
+    so fused vs host tokens must agree bit-for-bit. Production engines
+    never take this path: it moves b x vocab floats to the host every
+    step where the fused path moves b int32 tokens."""
+    import numpy as np
+
+    full = jnp.asarray(padded_logits,
+                       jnp.float32)[:, : model.cfg.vocab_size]
+    if temperature == 0.0:
+        return np.asarray(jnp.argmax(full, axis=-1).astype(jnp.int32))
+    scaled = _filter_logits(full / temperature, top_k, top_p)
+
+    def draw(seed, pos, row):
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(0), seed), pos)
+        return jax.random.categorical(key, row, axis=-1)
+
+    idx = jax.vmap(draw)(jnp.asarray(seeds, jnp.uint32),
+                         jnp.asarray(positions, jnp.int32), scaled)
+    return np.asarray(idx.astype(jnp.int32))
 
 
 def make_generate(model: Transformer, mesh: Mesh, buf_len: int,

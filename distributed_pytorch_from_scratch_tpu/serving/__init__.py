@@ -14,19 +14,28 @@ chunked prefill interleaved into the decode loop, preemption with
 resume-through-prefill), and `SLOScheduler` (TTFT deadline classes,
 per-tenant fairness). Same token-identity bar as v1, pinned in
 tests/test_serving_paged.py. See docs/SERVING.md.
+
+Speculative decoding (ISSUE 7): `SpeculativeEngine` drafts k tokens per
+round with a cheap drafter model over its own paged pool and verifies
+them in ONE target dispatch with exact rejection sampling — greedy output
+token-identical to the paged engine, sampled output distribution-
+identical, pinned in tests/test_speculative.py.
 """
 
 from .engine import (ContinuousBatchingEngine, PagedEngine, Request,
                      decode_prompts)
-from .kv_manager import KVCachePool, PagedKVPool, PoolExhausted
+from .kv_manager import (KVCachePool, PagedKVPool, PoolExhausted,
+                         kv_token_bytes, page_bytes)
 from .loadgen import run_loadgen, slo_attainment, synthetic_requests
 from .scheduler import (DEFAULT_SLO_CLASSES, FIFOScheduler, QueueFull,
                         SLOScheduler, bucket_width, parse_slo_classes)
+from .speculative import SpeculativeEngine
 
 __all__ = [
     "ContinuousBatchingEngine", "DEFAULT_SLO_CLASSES", "FIFOScheduler",
     "KVCachePool", "PagedEngine", "PagedKVPool", "PoolExhausted",
-    "QueueFull", "Request", "SLOScheduler", "bucket_width",
-    "decode_prompts", "parse_slo_classes", "run_loadgen", "slo_attainment",
+    "QueueFull", "Request", "SLOScheduler", "SpeculativeEngine",
+    "bucket_width", "decode_prompts", "kv_token_bytes", "page_bytes",
+    "parse_slo_classes", "run_loadgen", "slo_attainment",
     "synthetic_requests",
 ]

@@ -46,7 +46,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.decode import (_decode_one, _paged_decode_one,
                              _paged_prefill_chunk, _prefill,
-                             make_token_sampler, rope_tables)
+                             host_sample_tokens, make_token_sampler,
+                             rope_tables)
 from ..config import resolve_dtype
 from .kv_manager import (KVCachePool, POOL_SPEC, PagedKVPool, PoolExhausted)
 from .scheduler import FIFOScheduler, SLOScheduler
@@ -121,6 +122,28 @@ def _pow2_at_most(n: int, cap: int) -> int:
     return min(p, cap) if cap else p
 
 
+def _chunk_maps(ids, s: int, n: int, cw: int, ps: int, eos_id: int,
+                scratch_page: int, tbl_row):
+    """Host-side destination maps for one prefill chunk: the (1, cw) token
+    buffer eos-padded past n, and per-position destination page/offset.
+    Real positions land in `tbl_row`'s pages at (s+i)//ps, (s+i)%ps; pad
+    positions write the scratch page at distinct offsets so the scatter
+    never collides with live rows. Shared by the target engine's
+    `_dispatch_chunk` and the drafter's `_drafter_prefill` — the pad-offset
+    convention must stay identical on both sides."""
+    buf = np.full((1, cw), eos_id, np.int32)
+    buf[0, :n] = ids[s:s + n]
+    dstp = np.full((1, cw), scratch_page, np.int32)
+    dsto = np.zeros((1, cw), np.int32)
+    for i in range(cw):
+        if i < n:
+            dstp[0, i] = tbl_row[(s + i) // ps]
+            dsto[0, i] = (s + i) % ps
+        else:
+            dsto[0, i] = i % ps
+    return buf, dstp, dsto
+
+
 class ContinuousBatchingEngine:
     """Slot-based continuous batching over a TP-sharded KV pool.
 
@@ -134,8 +157,8 @@ class ContinuousBatchingEngine:
                  buf_len: int, eos_id: int, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
                  prefill_bucket: int = 64, max_prefill_batch: int = 4,
-                 max_queue: int = 0, tracer=None, writer=None,
-                 clock=time.monotonic):
+                 max_queue: int = 0, debug_host_sampler: bool = False,
+                 tracer=None, writer=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -162,6 +185,12 @@ class ContinuousBatchingEngine:
         self.writer = writer
         self._dtype = resolve_dtype(model.cfg.compute_dtype)
         self._table_len = max(model.cfg.maxlen, buf_len)
+        # sampling knobs kept on the engine: the fused in-program sampler
+        # stays the only production path; debug_host_sampler switches to
+        # host-side full-vocab sampling for the equivalence tests and the
+        # r10 cost ablation
+        self._temperature, self._top_k, self._top_p = temperature, top_k, top_p
+        self._debug_host_sampler = debug_host_sampler
         self._sample = make_token_sampler(model, temperature=temperature,
                                           top_k=top_k, top_p=top_p)
         self.pool = KVCachePool(model, mesh, num_slots, buf_len)
@@ -192,12 +221,18 @@ class ContinuousBatchingEngine:
 
     def _build_step(self, n: int):
         model, buf_len, dtype = self.model, self.buf_len, self._dtype
+        debug = self._debug_host_sampler
 
         def shard_fn(params, pool_k, pool_v, tokens, pos, seeds):
             cos_t, sin_t = self._tables()
             pool_k, pool_v, logits = _decode_one(
                 model, params, pool_k, pool_v, tokens, pos, buf_len,
                 cos_t, sin_t, dtype)
+            if debug:
+                # ablation: hand the LOCAL vocab shards back (the
+                # out_specs concatenation materialises full-vocab logits
+                # for the host) instead of sampling in-program
+                return pool_k, pool_v, logits.astype(jnp.float32)
             tok = self._sample(logits, seeds, pos + 1)
             return pool_k, pool_v, tok
 
@@ -205,7 +240,8 @@ class ContinuousBatchingEngine:
             shard_fn, mesh=self.mesh,
             in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None), P(None),
                       P(None)),
-            out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
+            out_specs=(POOL_SPEC, POOL_SPEC,
+                       P(None, "tp") if debug else P(None)))
         return jax.jit(fn, donate_argnums=(1, 2))
 
     def _build_prefill(self, nb: int, width: int):
@@ -340,7 +376,14 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._tokens), jnp.asarray(self._pos),
                 jnp.asarray(self._seeds))
             self.pool.adopt(ks, vs)
-            tok = np.asarray(tok)
+            if self._debug_host_sampler:
+                # `tok` is the (b, vocab_padded) full-vocab logits — the
+                # per-step host transfer the fused path avoids by design
+                tok = host_sample_tokens(
+                    self.model, np.asarray(tok), self._seeds, self._pos + 1,
+                    self._temperature, self._top_k, self._top_p)
+            else:
+                tok = np.asarray(tok)
         now = self._clock()
         self.decode_steps += 1
         self._occupancy_sum += self.pool.occupancy
@@ -455,8 +498,8 @@ class PagedEngine:
                  num_pages: int = 0, prefill_chunk: int = 128,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  slo_classes=None, default_class: str = "standard",
-                 max_queue: int = 0, tracer=None, writer=None,
-                 clock=time.monotonic):
+                 max_queue: int = 0, debug_host_sampler: bool = False,
+                 tracer=None, writer=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -494,6 +537,11 @@ class PagedEngine:
         self.writer = writer
         self._dtype = resolve_dtype(model.cfg.compute_dtype)
         self._table_len = max(model.cfg.maxlen, self.buf_len)
+        # fused in-program sampling is the only production path; the knobs
+        # stay on the engine for the host-debug sampler and the speculative
+        # subclass (serving/speculative.py reuses them for draft + verify)
+        self._temperature, self._top_k, self._top_p = temperature, top_k, top_p
+        self._debug_host_sampler = debug_host_sampler
         self._sample = make_token_sampler(model, temperature=temperature,
                                           top_k=top_k, top_p=top_p)
         self.pool = PagedKVPool(model, mesh, num_pages, page_size)
@@ -535,12 +583,15 @@ class PagedEngine:
 
     def _build_step(self):
         model, ps, dtype = self.model, self.page_size, self._dtype
+        debug = self._debug_host_sampler
 
         def shard_fn(params, pool_k, pool_v, tokens, pos, seeds, tbl):
             cos_t, sin_t = self._tables()
             pool_k, pool_v, logits = _paged_decode_one(
                 model, params, pool_k, pool_v, tokens, pos, tbl, ps,
                 cos_t, sin_t, dtype)
+            if debug:
+                return pool_k, pool_v, logits.astype(jnp.float32)
             tok = self._sample(logits, seeds, pos + 1)
             return pool_k, pool_v, tok
 
@@ -548,7 +599,8 @@ class PagedEngine:
             shard_fn, mesh=self.mesh,
             in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None), P(None),
                       P(None), P(None, None)),
-            out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
+            out_specs=(POOL_SPEC, POOL_SPEC,
+                       P(None, "tp") if debug else P(None)))
         return jax.jit(fn, donate_argnums=(1, 2))
 
     def _build_chunk(self, cw: int):
@@ -818,16 +870,9 @@ class PagedEngine:
         s, ids, req = st.s, st.ids, st.req
         self._ensure_writable(slot, s, s + n)
         cw = _pow2_at_most(n, self.prefill_chunk)
-        buf = np.full((1, cw), self.eos_id, np.int32)
-        buf[0, :n] = ids[s:s + n]
-        dstp = np.full((1, cw), self.pool.scratch_page, np.int32)
-        dsto = np.zeros((1, cw), np.int32)
-        for i in range(cw):
-            if i < n:
-                dstp[0, i] = self._tbl[slot, (s + i) // ps]
-                dsto[0, i] = (s + i) % ps
-            else:
-                dsto[0, i] = i % ps
+        buf, dstp, dsto = _chunk_maps(ids, s, n, cw, ps, self.eos_id,
+                                      self.pool.scratch_page,
+                                      self._tbl[slot])
         if cw not in self._chunk_fns:
             self._chunk_fns[cw] = self._build_chunk(cw)
         with self._span("prefill_chunk", slot=slot, pos0=s, n=n, cw=cw):
@@ -888,7 +933,12 @@ class PagedEngine:
                 jnp.asarray(self._tokens), jnp.asarray(self._pos),
                 jnp.asarray(self._seeds), jnp.asarray(self._tbl))
             self.pool.adopt(ks, vs)
-            tok = np.asarray(tok)
+            if self._debug_host_sampler:
+                tok = host_sample_tokens(
+                    self.model, np.asarray(tok), self._seeds, self._pos + 1,
+                    self._temperature, self._top_k, self._top_p)
+            else:
+                tok = np.asarray(tok)
         now = self._clock()
         self.decode_steps += 1
         live_tokens = sum(int(self._pos[s]) + 1 for s in self._slot_req)

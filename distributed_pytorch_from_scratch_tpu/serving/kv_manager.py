@@ -44,6 +44,22 @@ from ..config import resolve_dtype
 POOL_SPEC = P(None, None, "tp", None, None)
 
 
+def kv_token_bytes(cfg) -> int:
+    """K+V cache bytes per TOKEN POSITION at a model shape (all layers,
+    all kv heads, both K and V, global across tp). The equal-HBM accounting
+    unit: bench.py's serving A/B spends `slots x buf_len` of these on the
+    slot engine and must hand the paged/speculative arms the same number —
+    including the speculative drafter's pages, which buy acceptance, not
+    capacity, and therefore count against the budget."""
+    itemsize = jnp.dtype(resolve_dtype(cfg.compute_dtype)).itemsize
+    return 2 * cfg.num_layers * cfg.kv_heads * cfg.head_dim * itemsize
+
+
+def page_bytes(cfg, page_size: int) -> int:
+    """K+V bytes of ONE page at a model shape (scratch page excluded)."""
+    return kv_token_bytes(cfg) * page_size
+
+
 class KVCachePool:
     """Device-resident K/V pool + host-side slot free-list."""
 

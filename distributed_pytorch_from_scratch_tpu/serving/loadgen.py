@@ -184,6 +184,11 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
             "kv_util_mean", "kv_fragmentation_mean", "pages_in_use_mean",
             "prefix_hit_rate", "cow_copies", "preemptions", "max_live",
             "max_interleaved_prefill_positions")})
+    if "speculate_k" in stats:         # the speculative engine's telemetry
+        summary.update({k: stats[k] for k in (
+            "speculate_k", "spec_rounds", "accepted_tokens_per_dispatch",
+            "acceptance_rate", "acceptance_rate_by_position",
+            "rounds_per_request", "drafter_ms_total", "target_ms_total")})
     att = slo_attainment(engine, done)
     if att is not None:
         summary["slo_attainment"] = att
@@ -198,6 +203,17 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
                 "kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
                 "prefix_hit_tokens", "cow_copies", "preemptions",
                 "max_live", "max_interleaved_prefill_positions")})
+        if "speculate_k" in stats:
+            # the speculative round economics as their own event, so the
+            # staged r10 k-sweep (and summarize_run.py) can rank k by
+            # acceptance and drafter-vs-target wall without re-parsing
+            engine.writer.event("spec_decode_stats", **{k: stats[k] for k in (
+                "speculate_k", "spec_rounds",
+                "accepted_tokens_per_dispatch", "acceptance_rate",
+                "acceptance_rate_by_position", "rounds_per_request",
+                "drafter_ms_total", "target_ms_total",
+                "drafter_num_pages", "drafter_pages_in_use",
+                "drafter_page_bytes", "target_page_bytes")})
     return summary
 
 

@@ -26,12 +26,19 @@ import argparse
 import json
 import sys
 
+import dataclasses
+
 from ..cli import add_model_shape_args, build_model_config
-from ..config import BOS_TOKEN, EOS_TOKEN, MeshConfig, ModelConfig
+from ..config import (BOS_TOKEN, EOS_TOKEN, MODEL_PRESETS, MeshConfig,
+                      ModelConfig, model_preset)
 from ..runtime.mesh import make_mesh
 
 _DRY_CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=4, num_layers=2,
                        vocab_size=64, maxlen=64)
+# dry-run drafter: even smaller than the dry target, so the smoke actually
+# exercises the drafter-cheaper-than-target shape the feature assumes
+_DRY_DRAFTER_CFG = ModelConfig(attn_dim=16, ffn_dim=32, num_heads=2,
+                               num_layers=1, vocab_size=64, maxlen=64)
 
 
 def get_serve_args(argv=None) -> argparse.Namespace:
@@ -113,6 +120,34 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "(prompt_len_min / prompt_len_max) instead of "
                         "uniform lengths — the head-of-line stress")
 
+    g = p.add_argument_group("speculative decoding (--paged only)")
+    g.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="draft K tokens per round with the drafter model "
+                        "and verify them in ONE target dispatch (exact "
+                        "rejection sampling — greedy output is token-"
+                        "identical to the plain paged engine); 0 = off")
+    g.add_argument("--drafter_model", choices=sorted(MODEL_PRESETS),
+                   default="tiny",
+                   help="drafter shape preset (vocab is forced to the "
+                        "target's; ROADMAP's cheap-drafter default is "
+                        "'tiny')")
+    g.add_argument("--drafter_ckpt_dir", default=None,
+                   help="load drafter weights from this checkpoint "
+                        "(default: random init — fine for latency "
+                        "benchmarks, useless acceptance on real text)")
+    g.add_argument("--drafter_iter", type=int, default=None,
+                   help="drafter checkpoint iteration (default: latest)")
+    g.add_argument("--drafter_pages", type=int, default=0,
+                   help="drafter page-pool budget in pages (0 = every "
+                        "slot can hold its full drafter row); counts "
+                        "against the serving HBM budget in bench A/Bs")
+    g.add_argument("--debug_host_sampler", action="store_true",
+                   help="ABLATION: switch to host-side sampling "
+                        "(materialises full-vocab logits on the host every "
+                        "step) — prices the host-round-trip cost the fused "
+                        "in-program sampler (the production path since the "
+                        "engines shipped) avoids; excludes --speculate")
+
     g = p.add_argument_group("loadgen")
     g.add_argument("--num_requests", type=int, default=32)
     g.add_argument("--rate", type=float, default=4.0,
@@ -147,6 +182,20 @@ def get_serve_args(argv=None) -> argparse.Namespace:
         if args.tenants != 1:
             p.error("--tenants needs --paged (the FIFO engine ignores "
                     "tenants — the run would measure nothing fair)")
+    if args.speculate:
+        if not args.paged:
+            p.error("--speculate runs over the paged cache; add --paged")
+        if args.debug_host_sampler:
+            p.error("--debug_host_sampler is the NON-speculative ablation "
+                    "knob (a speculative round never materialises host "
+                    "logits); drop --speculate to measure it")
+        if args.drafter_iter is not None and not args.drafter_ckpt_dir:
+            p.error("--drafter_iter needs --drafter_ckpt_dir (without one "
+                    "the drafter is random-init and the iter is ignored)")
+    elif (args.drafter_ckpt_dir or args.drafter_pages
+          or args.drafter_iter is not None):
+        p.error("--drafter_ckpt_dir/--drafter_iter/--drafter_pages need "
+                "--speculate K")
     if args.arrival == "replay" and not args.replay and not args.dry_run:
         p.error("--arrival replay needs --replay PATH")
     if not args.dry_run and not args.random_init and not args.ckpt_dir:
@@ -174,6 +223,43 @@ def _load_params(args, model, mesh):
     print(f"serving checkpoint iter {step} from {args.ckpt_dir}",
           file=sys.stderr)
     return jax.device_put(params, model.shardings(mesh))
+
+
+def _build_drafter(args, vocab_size: int, mesh, family: str):
+    """Drafter model + params for --speculate: the named preset reshaped to
+    the TARGET's vocab (the verify step compares distributions over one
+    vocabulary), weights from --drafter_ckpt_dir or random init."""
+    import jax
+
+    if args.dry_run:
+        dcfg = _DRY_DRAFTER_CFG
+    else:
+        dcfg = model_preset(args.drafter_model)
+    dcfg = dataclasses.replace(
+        dcfg, vocab_size=vocab_size,
+        compute_dtype="bfloat16" if getattr(args, "bf16", True) and
+        not args.dry_run else "float32")
+    if family == "gpt2":
+        from ..models.gpt2 import GPT2Transformer
+        dmodel = GPT2Transformer(dcfg, tp_size=args.tp_size)
+    else:
+        from ..models.transformer import Transformer
+        dmodel = Transformer(dcfg, tp_size=args.tp_size)
+    if args.drafter_ckpt_dir:
+        from ..training.checkpoint import latest_step, load_checkpoint
+        step = (args.drafter_iter if args.drafter_iter is not None
+                else latest_step(args.drafter_ckpt_dir))
+        if step is None:
+            raise SystemExit(
+                f"no drafter checkpoints found in {args.drafter_ckpt_dir}")
+        template = jax.eval_shape(lambda: dmodel.init(jax.random.key(0)))
+        dparams, _, _ = load_checkpoint(args.drafter_ckpt_dir, step,
+                                        template, dmodel.specs())
+        print(f"drafter checkpoint iter {step} from {args.drafter_ckpt_dir}",
+              file=sys.stderr)
+    else:
+        dparams = dmodel.init(jax.random.key(args.seed + 1))
+    return dmodel, jax.device_put(dparams, dmodel.shardings(mesh))
 
 
 def serve(args: argparse.Namespace) -> dict:
@@ -246,18 +332,29 @@ def serve(args: argparse.Namespace) -> dict:
     writer = MetricsWriter(args.log_dir, process_index=0)
     try:
         if args.paged:
-            from .engine import PagedEngine
             from .scheduler import parse_slo_classes
-            engine = PagedEngine(
-                model, mesh, params, num_slots=args.slots, buf_len=buf_len,
-                eos_id=eos_id, page_size=args.page_size,
-                num_pages=args.num_pages,
+            paged_kw = dict(
+                num_slots=args.slots, buf_len=buf_len, eos_id=eos_id,
+                page_size=args.page_size, num_pages=args.num_pages,
                 prefill_chunk=args.prefill_chunk,
                 temperature=args.temperature, top_k=args.decode_top_k,
                 top_p=args.decode_top_p,
                 slo_classes=parse_slo_classes(args.slo_classes),
                 default_class=args.default_class,
                 max_queue=args.queue_limit, tracer=tracer, writer=writer)
+            if args.speculate:
+                from .speculative import SpeculativeEngine
+                dmodel, dparams = _build_drafter(args, cfg.vocab_size, mesh,
+                                                 args.family)
+                engine = SpeculativeEngine(
+                    model, mesh, params, dmodel, dparams,
+                    speculate_k=args.speculate,
+                    drafter_pages=args.drafter_pages, **paged_kw)
+            else:
+                from .engine import PagedEngine
+                engine = PagedEngine(
+                    model, mesh, params,
+                    debug_host_sampler=args.debug_host_sampler, **paged_kw)
         else:
             engine = ContinuousBatchingEngine(
                 model, mesh, params, num_slots=args.slots, buf_len=buf_len,
@@ -265,7 +362,9 @@ def serve(args: argparse.Namespace) -> dict:
                 top_k=args.decode_top_k, top_p=args.decode_top_p,
                 prefill_bucket=args.prefill_bucket,
                 max_prefill_batch=args.max_prefill_batch,
-                max_queue=args.queue_limit, tracer=tracer, writer=writer)
+                max_queue=args.queue_limit,
+                debug_host_sampler=args.debug_host_sampler,
+                tracer=tracer, writer=writer)
         summary = run_loadgen(engine, requests)
     finally:
         path = tracer.close()
@@ -288,10 +387,19 @@ def serve(args: argparse.Namespace) -> dict:
              f"{100 * summary['prefix_hit_rate']:.0f}%, "
              f"{summary['preemptions']} preempted"
              if "kv_util_mean" in summary else "")
+          + (f"; spec k={summary['speculate_k']}: "
+             f"{summary['accepted_tokens_per_dispatch']:.2f} tok/dispatch, "
+             f"acceptance {100 * summary['acceptance_rate']:.0f}%"
+             if "speculate_k" in summary else "")
           + (f"; trace {path}" if path else ""), file=sys.stderr)
     rec = {
         "metric": (f"serving tokens/sec ({args.family}, tp={args.tp_size}, "
                    + ("paged, " if args.paged else "")
+                   + (f"speculate k={args.speculate} "
+                      f"({args.drafter_model} drafter), "
+                      if args.speculate else "")
+                   + ("HOST-sampler ablation, "
+                      if args.debug_host_sampler else "")
                    + f"slots={args.slots}, {args.arrival} arrivals"
                    + (f" @{args.rate:g}/s" if args.arrival == "poisson"
                       else "") + ")"),
@@ -305,9 +413,14 @@ def serve(args: argparse.Namespace) -> dict:
     }
     for k in ("kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
               "cow_copies", "preemptions", "max_live",
-              "max_interleaved_prefill_positions", "slo_attainment"):
+              "max_interleaved_prefill_positions", "slo_attainment",
+              "speculate_k", "spec_rounds", "accepted_tokens_per_dispatch",
+              "acceptance_rate", "acceptance_rate_by_position",
+              "rounds_per_request", "drafter_ms_total", "target_ms_total"):
         if k in summary:
             rec[k] = summary[k]
+    if args.debug_host_sampler:
+        rec["debug_host_sampler"] = True
     print(json.dumps(rec))
     return summary
 

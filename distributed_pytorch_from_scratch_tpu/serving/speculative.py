@@ -1,0 +1,579 @@
+"""Speculative decoding over the paged KV cache (ISSUE 7).
+
+BASELINE.md's roofline finding is that decode at the 45M-355M scale is
+DISPATCH-latency-bound, not FLOP-bound: one model dispatch per generated
+token, plus a device->host round-trip per step to learn the token. This
+engine attacks the dispatch count itself: a cheap DRAFTER model (default:
+the `tiny` preset) autoregressively proposes k tokens per round against
+its own small paged KV pool, and the target model scores all k+1
+positions in ONE dispatch — `models/decode._paged_prefill_chunk` with
+`all_logits=True`, i.e. `_paged_decode_one`'s per-row cursor generalised
+to advance k positions through the same page table, with page growth and
+COW resolved by the host before the dispatch exactly like a prefill
+chunk. Per round the host sees only (accepted_count, tokens): one D2H of
+a handful of int32s buys up to k+1 tokens.
+
+Correctness contract (pinned in tests/test_speculative.py):
+
+* **greedy (temperature 0)** — a draft token is accepted iff it equals
+  the target argmax at its position, and the first rejection (or the
+  bonus position) emits the target argmax itself, so the emitted stream
+  is TOKEN-IDENTICAL to the non-speculative paged engine (and therefore
+  to the slot engine and per-prompt `GreedyDecoder`) whatever the
+  drafter proposes — across k, page sizes, arrival orders, COW sharing,
+  and preempt-resume. A bad drafter costs speed, never tokens.
+* **sampled (temperature > 0)** — exact rejection sampling: draft d ~ q
+  is accepted with probability min(1, p(d)/q(d)); the first rejection
+  resamples from the residual distribution norm(max(p - q, 0)); an
+  all-accept round draws the free bonus token from p directly. The
+  emitted tokens are DISTRIBUTION-identical to the plain sampler
+  (Leviathan et al.'s guarantee), pinned by a chi-square test. Draft /
+  accept / resample draws fold (request_seed, absolute_position,
+  stream_tag), so a request's randomness stays independent of batch mix
+  and round boundaries.
+
+Drafter state threads through the SAME retire -> admit -> decode loop as
+`PagedEngine`: the drafter leases pages from its own pool under the same
+accounting (its bytes count against the serving HBM budget — bench.py's
+equal-HBM A/B subtracts them from the target pool), a preempted victim
+frees BOTH page lists, and a resumed (or freshly admitted) request
+rebuilds the drafter cache through the same chunked-prefill path that
+rebuilds the target cache. The drafter never COW-shares: at drafter
+scale, recompute is cheaper than index bookkeeping.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..config import resolve_dtype
+from ..models.decode import (_filter_logits, _full_vocab_logits,
+                             _paged_decode_one, _paged_prefill_chunk,
+                             rope_tables)
+from .engine import PagedEngine, Request, _chunk_maps, _pow2_at_most
+from .kv_manager import POOL_SPEC, PagedKVPool, PoolExhausted, page_bytes
+
+# Randomness stream tags: every speculative draw folds
+# (seed, absolute_position, TAG), so the drafter's proposal draw, the
+# accept threshold, and the residual resample are mutually independent AND
+# independent of the plain sampler's (seed, position) stream — the same
+# reproducibility contract make_token_sampler gives continuous batching.
+TAG_DRAFT, TAG_ACCEPT, TAG_RESAMPLE = 1, 2, 3
+
+
+def _spec_key(seed, pos, tag):
+    """fold_in chain for one speculative draw (called under vmap)."""
+    key = jax.random.fold_in(jax.random.key(0), seed)
+    key = jax.random.fold_in(key, pos)
+    return jax.random.fold_in(key, tag)
+
+
+class SpeculativeEngine(PagedEngine):
+    """`PagedEngine` with a drafter: k tokens drafted per round by a small
+    model over its own paged pool, verified by the target in one
+    k+1-position dispatch with exact rejection sampling.
+
+    Two extra compiled programs (both donate their pool):
+
+    * **draft** — one jit variant: a `lax.scan` of k+1 drafter
+      single-token steps fused into ONE dispatch (step j feeds the round's
+      j-th token at cursor+j and samples the next proposal). The extra
+      (k+1)-th step consumes the LAST draft token so the drafter cache
+      stays complete through an all-accept round; its own proposal is
+      discarded. Emits the k draft tokens (+ the drafter's full-vocab
+      proposal distributions when sampling — the q the accept ratio
+      needs), which stay ON DEVICE for the verify dispatch.
+    * **verify** — one jit variant: the target scores all k+1 positions
+      (`_paged_prefill_chunk(all_logits=True)` over the row's page view,
+      per-row cursors), runs the accept/resample rule in-program, and
+      returns only (accepted_count, tokens) — the whole round is one D2H
+      of 2(k+2) ints per row.
+
+    Rows whose buffer cannot fit k+1 more positions verify a shorter
+    window (per-row `qlen`); rows at qlen=1 degenerate to the
+    non-speculative step. Rejected positions' K/V writes are garbage
+    beyond the new cursor — masked now, overwritten by the next round
+    before anything attends to them (the standard quarantine argument).
+    """
+
+    def __init__(self, model, mesh, params, drafter_model, drafter_params,
+                 num_slots: int, buf_len: int, eos_id: int,
+                 speculate_k: int = 4, drafter_pages: int = 0, **kw):
+        if speculate_k < 1:
+            raise ValueError(f"speculate_k must be >= 1, got {speculate_k}")
+        if kw.get("debug_host_sampler"):
+            raise ValueError(
+                "debug_host_sampler is the NON-speculative engines' "
+                "ablation knob (the speculative round never materialises "
+                "host logits); drop --speculate to measure it")
+        super().__init__(model, mesh, params, num_slots, buf_len, eos_id,
+                         **kw)
+        if drafter_model.cfg.vocab_size != model.cfg.vocab_size:
+            raise ValueError(
+                f"drafter vocab {drafter_model.cfg.vocab_size} != target "
+                f"vocab {model.cfg.vocab_size} — build the drafter preset "
+                f"with the target's vocab_size (serve.py does)")
+        if getattr(drafter_model, "cp_size", 1) > 1:
+            raise ValueError("the drafter decodes on the cp=1 path, like "
+                             "the target (serving engine contract)")
+        self.k = int(speculate_k)
+        self.drafter_model = drafter_model
+        self._dparams = drafter_params
+        self._ddtype = resolve_dtype(drafter_model.cfg.compute_dtype)
+        ps = self.page_size
+        # the drafter logically buffers buf_len + k + 1 positions: on an
+        # all-accept round it has consumed one token PAST the last position
+        # the target buffer holds
+        self._d_max_pages = -(-(self.buf_len + self.k + 1) // ps)
+        dbuf = self._d_max_pages * ps
+        cap = getattr(drafter_model, "max_decode_positions", None)
+        if cap is not None and dbuf > cap:
+            raise ValueError(
+                f"drafter buffer {dbuf} (buf_len {self.buf_len} + k "
+                f"{self.k} + 1, page-rounded) exceeds the drafter's "
+                f"learned position table ({cap}); pick a RoPE drafter or "
+                f"shrink the buffer")
+        self._dtable_len = max(drafter_model.cfg.maxlen, dbuf)
+        if not drafter_pages:
+            # default: every slot can hold its full drafter row — the
+            # drafter pool is never the binding resource unless the caller
+            # squeezes it (bench.py's equal-HBM arm does, via the budget)
+            drafter_pages = num_slots * self._d_max_pages
+        self.dpool = PagedKVPool(drafter_model, mesh, drafter_pages, ps)
+        self._dtbl = np.full((num_slots, self._d_max_pages),
+                             self.dpool.scratch_page, np.int32)
+        self._draft_fn = self._build_draft()
+        self._verify_fn = self._build_verify()
+        self._dchunk_fns = {}
+        # -- speculative stats -------------------------------------------
+        self.spec_rounds = 0                 # verify dispatches
+        self.spec_row_rounds = 0             # Σ live rows over rounds
+        self.spec_emitted = 0                # tokens emitted by rounds
+        self.drafter_s = 0.0                 # draft + drafter-prefill wall
+        self.target_s = 0.0                  # verify wall
+        self._acc_attempt = np.zeros(self.k, np.int64)
+        self._acc_accept = np.zeros(self.k, np.int64)
+
+    # -- compiled programs ------------------------------------------------
+    def _dtables(self):
+        if not self.drafter_model.uses_rope:
+            return None, None
+        return rope_tables(self._dtable_len,
+                           self.drafter_model.cfg.head_dim,
+                           self.drafter_model.cfg.rope_theta)
+
+    def _build_draft(self):
+        model, ps, k = self.drafter_model, self.page_size, self.k
+        dtype = self._ddtype
+        temperature, top_k, top_p = (self._temperature, self._top_k,
+                                     self._top_p)
+
+        def shard_fn(params, pool_k, pool_v, tokens, pos, seeds, tbl):
+            cos_t, sin_t = self._dtables()
+            pos = jnp.asarray(pos, jnp.int32)
+
+            def body(carry, j):
+                pk, pv, tok = carry
+                pk, pv, logits = _paged_decode_one(
+                    model, params, pk, pv, tok, pos + j, tbl, ps,
+                    cos_t, sin_t, dtype)
+                full = _full_vocab_logits(model, logits)     # (b, V) f32
+                if temperature == 0.0:
+                    nxt = jnp.argmax(full, axis=-1).astype(jnp.int32)
+                    q = full                   # dead on the greedy path
+                else:
+                    scaled = _filter_logits(full / temperature, top_k,
+                                            top_p)
+                    q = jax.nn.softmax(scaled, axis=-1)
+
+                    def draw(seed, p, row):
+                        return jax.random.categorical(
+                            _spec_key(seed, p, TAG_DRAFT), row, axis=-1)
+
+                    nxt = jax.vmap(draw)(
+                        seeds.astype(jnp.uint32),
+                        (pos + j + 1).astype(jnp.int32),
+                        scaled).astype(jnp.int32)
+                nxt = lax.pmax(nxt, "tp")
+                return (pk, pv, nxt), (nxt, q)
+
+            (pool_k, pool_v, _), (drafts, qs) = lax.scan(
+                body, (pool_k, pool_v, jnp.asarray(tokens, jnp.int32)),
+                jnp.arange(k + 1, dtype=jnp.int32))
+            draft = drafts[:k].T                             # (b, k)
+            if temperature == 0.0:
+                return pool_k, pool_v, draft
+            q = lax.pmax(qs[:k].transpose(1, 0, 2), "tp")    # (b, k, V)
+            return pool_k, pool_v, draft, q
+
+        out = (POOL_SPEC, POOL_SPEC, P(None, None))
+        if temperature != 0.0:
+            out = out + (P(None, None, None),)
+        fn = jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None),
+                      P(None), P(None), P(None, None)),
+            out_specs=out)
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _build_verify(self):
+        model, ps, k = self.model, self.page_size, self.k
+        dtype = self._dtype
+        temperature, top_k, top_p = (self._temperature, self._top_k,
+                                     self._top_p)
+        cw = k + 1
+
+        def leading(accept, qlen):
+            """Per-row count of leading accepted drafts, capped by the
+            row's valid verify window (draft i sits at window slot i+1)."""
+            valid = ((jnp.arange(k, dtype=jnp.int32)[None, :] + 1)
+                     < qlen[:, None])
+            lead = jnp.cumprod((accept & valid).astype(jnp.int32), axis=1)
+            return lead.sum(axis=1).astype(jnp.int32)
+
+        def shard_fn(params, pool_k, pool_v, tokens, draft, pos, qlen, tbl,
+                     dstp, dsto, seeds, *maybe_q):
+            cos_t, sin_t = self._tables()
+            pos = jnp.asarray(pos, jnp.int32)
+            qlen = jnp.asarray(qlen, jnp.int32)
+            block = jnp.concatenate(
+                [jnp.asarray(tokens, jnp.int32)[:, None],
+                 jnp.asarray(draft, jnp.int32)], axis=1)      # (b, cw)
+            pool_k, pool_v, logits = _paged_prefill_chunk(
+                model, params, pool_k, pool_v, block, pos, qlen, tbl,
+                dstp, dsto, ps, cos_t, sin_t, dtype, all_logits=True)
+            full = _full_vocab_logits(model, logits)          # (b, cw, V)
+            b = block.shape[0]
+            if temperature == 0.0:
+                tgt = jnp.argmax(full, axis=-1).astype(jnp.int32)
+                n_acc = leading(block[:, 1:] == tgt[:, :k], qlen)
+                nxt = jnp.take_along_axis(tgt, n_acc[:, None],
+                                          axis=1)[:, 0]
+            else:
+                qprobs = maybe_q[0]                           # (b, k, V)
+                scaled = _filter_logits(
+                    full.reshape(b * cw, -1) / temperature, top_k, top_p)
+                p = jax.nn.softmax(scaled, axis=-1).reshape(b, cw, -1)
+                d = block[:, 1:]                              # (b, k)
+                p_d = jnp.take_along_axis(p[:, :k], d[..., None],
+                                          axis=-1)[..., 0]
+                q_d = jnp.take_along_axis(qprobs, d[..., None],
+                                          axis=-1)[..., 0]
+                posm = (pos[:, None] + 1
+                        + jnp.arange(k, dtype=jnp.int32)[None, :])
+
+                def u_one(seed, pp):
+                    return jax.random.uniform(
+                        _spec_key(seed, pp, TAG_ACCEPT), ())
+
+                u = jax.vmap(jax.vmap(u_one, in_axes=(None, 0)))(
+                    seeds.astype(jnp.uint32), posm)
+                # u < p/q  <=>  u*q < p (no div-by-zero; q(d) > 0 for a
+                # token actually drawn from q)
+                n_acc = leading(u * q_d < p_d, qlen)
+                # residual at the first rejected position. q is ZEROED at
+                # slot k (the all-accept bonus draw) AND at draft slots
+                # outside the row's verify window: there the "rejection"
+                # was forced by the window, not by an accept test, so the
+                # exact draw is from p itself — max(p - 0, 0) = p. Only a
+                # REAL rejection (draft tested and refused) subtracts q.
+                valid = ((jnp.arange(k, dtype=jnp.int32)[None, :] + 1)
+                         < qlen[:, None])                 # (b, k)
+                qpad = jnp.concatenate(
+                    [jnp.where(valid[..., None], qprobs, 0.0),
+                     jnp.zeros_like(qprobs[:, :1])], axis=1)
+                p_at = jnp.take_along_axis(
+                    p, n_acc[:, None, None], axis=1)[:, 0]
+                q_at = jnp.take_along_axis(
+                    qpad, n_acc[:, None, None], axis=1)[:, 0]
+                res = jnp.maximum(p_at - q_at, 0.0)
+                # p == q exactly zeroes the residual (probability-0 event
+                # under real draws — only garbage rows hit it); fall back
+                # to p so categorical always sees a distribution
+                res = jnp.where(res.sum(-1, keepdims=True) > 0.0, res,
+                                p_at)
+
+                def draw(seed, pp, row):
+                    return jax.random.categorical(
+                        _spec_key(seed, pp, TAG_RESAMPLE),
+                        jnp.log(jnp.maximum(row, 1e-30)), axis=-1)
+
+                nxt = jax.vmap(draw)(
+                    seeds.astype(jnp.uint32),
+                    (pos + 1 + n_acc).astype(jnp.int32),
+                    res).astype(jnp.int32)
+            out = jnp.concatenate(
+                [block[:, 1:], jnp.zeros((b, 1), jnp.int32)], axis=1)
+            out = out.at[jnp.arange(b), n_acc].set(nxt)
+            # every tp shard computed the same verdict; pmax clears the
+            # varying tags (the sampler convention)
+            return (pool_k, pool_v, lax.pmax(n_acc, "tp"),
+                    lax.pmax(out, "tp"))
+
+        in_specs = [model.specs(), POOL_SPEC, POOL_SPEC, P(None),
+                    P(None, None), P(None), P(None), P(None, None),
+                    P(None, None), P(None, None), P(None)]
+        if temperature != 0.0:
+            in_specs.append(P(None, None, None))
+        fn = jax.shard_map(
+            shard_fn, mesh=self.mesh, in_specs=tuple(in_specs),
+            out_specs=(POOL_SPEC, POOL_SPEC, P(None), P(None, None)))
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    def _build_drafter_chunk(self, cw: int):
+        model, ps, dtype = self.drafter_model, self.page_size, self._ddtype
+
+        def shard_fn(params, pool_k, pool_v, chunk, start, qlen, tbl,
+                     dstp, dsto):
+            cos_t, sin_t = self._dtables()
+            pool_k, pool_v, _ = _paged_prefill_chunk(
+                model, params, pool_k, pool_v, chunk, start, qlen, tbl,
+                dstp, dsto, ps, cos_t, sin_t, dtype)
+            # only the K/V writes matter: the draft loop re-reads the cache
+            # next round (the dead logits head DCEs out of the program)
+            return pool_k, pool_v
+
+        fn = jax.shard_map(
+            shard_fn, mesh=self.mesh,
+            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None, None),
+                      P(None), P(None), P(None, None), P(None, None),
+                      P(None, None)),
+            out_specs=(POOL_SPEC, POOL_SPEC))
+        return jax.jit(fn, donate_argnums=(1, 2))
+
+    # -- request intake ---------------------------------------------------
+    def submit(self, req: Request) -> None:
+        """`PagedEngine.submit` plus the drafter-side worst-case check:
+        admitted work must fit BOTH pools alone, else preemption could
+        deadlock with the request as the sole survivor."""
+        need_d = -(-min(len(req.prompt) + req.max_new + self.k + 1,
+                        self._d_max_pages * self.page_size)
+                   // self.page_size)
+        if need_d > self.dpool.num_pages:
+            raise ValueError(
+                f"request {req.rid}: drafter needs up to {need_d} pages "
+                f"but the drafter pool has {self.dpool.num_pages} — raise "
+                f"--drafter_pages or lower the budget")
+        super().submit(req)
+
+    # -- drafter page plumbing -------------------------------------------
+    def _dalloc_page(self, needy_slot: int) -> int:
+        while True:
+            try:
+                return self.dpool.alloc()
+            except PoolExhausted:
+                cands = self._candidates(exclude_slot=needy_slot)
+                if not cands:
+                    raise RuntimeError(
+                        "drafter page pool exhausted with no preemption "
+                        "candidate — a single request outgrew "
+                        "drafter_pages (submit-time validation should "
+                        "have refused it)")
+                self._preempt(cands[0][0])
+
+    def _ensure_drafter_writable(self, slot: int, lo: int, hi: int) -> None:
+        """Drafter pages for positions [lo, hi): always private (the
+        drafter never COW-shares), so unmapped entries just allocate."""
+        ps, scratch = self.page_size, self.dpool.scratch_page
+        for j in range(lo // ps, -(-hi // ps)):
+            if self._dtbl[slot, j] == scratch:
+                self._dtbl[slot, j] = self._dalloc_page(slot)
+
+    def _release_slot(self, slot: int) -> None:
+        # retire/preempt frees BOTH page lists — the drafter's first, so a
+        # preemption triggered from target-page pressure cannot leak the
+        # drafter rows
+        scratch = self.dpool.scratch_page
+        for j in range(self._d_max_pages):
+            if self._dtbl[slot, j] != scratch:
+                self.dpool.unref(int(self._dtbl[slot, j]))
+                self._dtbl[slot, j] = scratch
+        super()._release_slot(slot)
+
+    # -- drafter prefill (admission and preempt-resume) -------------------
+    def _drafter_prefill(self, slot: int, ids: List[int]) -> None:
+        """Materialise the drafter's K/V for the whole prefix `ids` in
+        `prefill_chunk`-sized dispatches. No prefix index on the drafter
+        side: the shared-prefix positions the target COW-skipped are
+        recomputed here at drafter cost (~the ratio of the two models'
+        per-token FLOPs — docs/SERVING.md prices it)."""
+        ps = self.page_size
+        s = 0
+        while s < len(ids):
+            n = min(len(ids) - s, self.prefill_chunk)
+            self._ensure_drafter_writable(slot, s, s + n)
+            cw = _pow2_at_most(n, self.prefill_chunk)
+            buf, dstp, dsto = _chunk_maps(ids, s, n, cw, ps, self.eos_id,
+                                          self.dpool.scratch_page,
+                                          self._dtbl[slot])
+            if cw not in self._dchunk_fns:
+                self._dchunk_fns[cw] = self._build_drafter_chunk(cw)
+            t0 = time.monotonic()
+            with self._span("drafter_prefill_chunk", slot=slot, pos0=s,
+                            n=n):
+                dk, dv = self._dchunk_fns[cw](
+                    self._dparams, self.dpool.ks, self.dpool.vs,
+                    jnp.asarray(buf), jnp.asarray([s], np.int32),
+                    jnp.asarray([n], np.int32),
+                    jnp.asarray(self._dtbl[slot:slot + 1]),
+                    jnp.asarray(dstp), jnp.asarray(dsto))
+                self.dpool.adopt(dk, dv)
+                jax.block_until_ready(self.dpool.ks)
+            self.drafter_s += time.monotonic() - t0
+            s += n
+
+    def _finish_prefill(self, slot, st, first, done) -> None:
+        # the target cache is complete; build the drafter's before the slot
+        # goes live (a preempt-resumed request passes through here too, so
+        # both caches rebuild from the same prompt+generated prefix)
+        self._drafter_prefill(slot, st.ids)
+        super()._finish_prefill(slot, st, first, done)
+
+    # -- the speculative decode round -------------------------------------
+    def _decode(self, done: List[Request]) -> None:
+        k, ps = self.k, self.page_size
+        # page growth / COW for every live slot's verify window FIRST —
+        # target pages for [pos, pos+qlen), private drafter pages for
+        # [pos, pos+k+1). Either may preempt victims, so iterate snapshots
+        # and re-check liveness (the parent step's pattern).
+        for slot in list(self._slot_req):
+            if slot not in self._slot_req:
+                continue
+            pos = int(self._pos[slot])
+            self._ensure_writable(slot, pos,
+                                  pos + min(k + 1, self.buf_len - pos))
+        for slot in list(self._slot_req):
+            if slot not in self._slot_req:
+                continue
+            pos = int(self._pos[slot])
+            self._ensure_drafter_writable(slot, pos, pos + k + 1)
+        if not self._slot_req:
+            return
+        b = self.num_slots
+        dstp = np.full((b, k + 1), self.pool.scratch_page, np.int32)
+        dsto = np.tile(np.arange(k + 1, dtype=np.int32)[None, :] % ps,
+                       (b, 1))
+        qlen = np.zeros(b, np.int32)          # free rows: nothing valid
+        for slot in self._slot_req:
+            pos = int(self._pos[slot])
+            ql = min(k + 1, self.buf_len - pos)
+            qlen[slot] = ql
+            for i in range(ql):
+                dstp[slot, i] = self._tbl[slot, (pos + i) // ps]
+                dsto[slot, i] = (pos + i) % ps
+        t0 = time.monotonic()
+        with self._span("draft", live=len(self._slot_req), k=k):
+            args = (self._dparams, self.dpool.ks, self.dpool.vs,
+                    jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                    jnp.asarray(self._seeds), jnp.asarray(self._dtbl))
+            if self._temperature == 0.0:
+                dk, dv, draft = self._draft_fn(*args)
+                qprobs = None
+            else:
+                dk, dv, draft, qprobs = self._draft_fn(*args)
+            self.dpool.adopt(dk, dv)
+            # sync so the drafter/target wall split is honest (draft and
+            # qprobs stay ON DEVICE — the verify consumes them directly)
+            jax.block_until_ready(draft)
+        self.drafter_s += time.monotonic() - t0
+        t0 = time.monotonic()
+        with self._span("verify", live=len(self._slot_req), k=k):
+            vargs = [self.params, self.pool.ks, self.pool.vs,
+                     jnp.asarray(self._tokens), draft,
+                     jnp.asarray(self._pos), jnp.asarray(qlen),
+                     jnp.asarray(self._tbl), jnp.asarray(dstp),
+                     jnp.asarray(dsto), jnp.asarray(self._seeds)]
+            if qprobs is not None:
+                vargs.append(qprobs)
+            ks, vs, n_acc, out = self._verify_fn(*vargs)
+            self.pool.adopt(ks, vs)
+            # the round's ONLY device->host transfer: 2(k+2) ints per row
+            n_acc, out = np.asarray(n_acc), np.asarray(out)
+        self.target_s += time.monotonic() - t0
+        now = self._clock()
+        self.decode_steps += 1
+        self.spec_rounds += 1
+        self.spec_row_rounds += len(self._slot_req)
+        live_tokens = sum(int(self._pos[s]) + 1 for s in self._slot_req)
+        live_tokens += sum(st.s for st in self._prefilling.values())
+        used = self.pool.pages_in_use
+        self._occupancy_sum += self.live_requests / self.num_slots
+        self._pages_used_sum += used
+        if used:
+            self._kv_util_sum += live_tokens / (used * self.page_size)
+        if self.tracer is not None:
+            self.tracer.counter("slots_live", len(self._slot_req))
+            self.tracer.counter("pages_in_use", used)
+        for slot, req in list(self._slot_req.items()):
+            na = int(n_acc[slot])
+            n_att = min(k, int(qlen[slot]) - 1)
+            for j in range(min(na, n_att)):
+                self._acc_attempt[j] += 1
+                self._acc_accept[j] += 1
+            if na < n_att:
+                self._acc_attempt[na] += 1    # the first rejected draft
+            # the pending token was written at `pos` by the verify
+            # dispatch: emitted (the non-speculative step's contract)
+            req.tokens.append(int(self._tokens[slot]))
+            self.generated_tokens += 1
+            self.spec_emitted += 1
+            adv, finished = 1, False
+            for j in range(na + 1):
+                cand = int(out[slot, j])
+                if (cand == self.eos_id
+                        or req.prompt_len + len(req.tokens) >= req.limit):
+                    req.finish_t = now
+                    del self._slot_req[slot]
+                    self._release_slot(slot)
+                    self._complete(req, done)
+                    finished = True
+                    break
+                if j < na:                    # an accepted draft: emitted
+                    req.tokens.append(cand)
+                    self.generated_tokens += 1
+                    self.spec_emitted += 1
+                    adv += 1
+                else:                         # the round's new pending
+                    self._tokens[slot] = cand
+            if not finished:
+                self._pos[slot] += adv
+
+    # -- aggregate view ---------------------------------------------------
+    def stats(self) -> dict:
+        st = super().stats()
+        att = np.maximum(self._acc_attempt, 1)
+        st.update({
+            "speculate_k": self.k,
+            "spec_rounds": self.spec_rounds,
+            # emitted tokens per ROW per TARGET dispatch — the headline:
+            # the non-speculative engine emits exactly 1.0 (one token per
+            # live slot per decode dispatch), a perfect drafter k+1.
+            # Normalised per row so batch width cannot masquerade as
+            # acceptance.
+            "accepted_tokens_per_dispatch": round(
+                self.spec_emitted / max(self.spec_row_rounds, 1), 4),
+            "acceptance_rate_by_position": [
+                round(float(a) / float(t), 4)
+                for a, t in zip(self._acc_accept, att)],
+            "acceptance_rate": round(
+                float(self._acc_accept.sum())
+                / max(float(self._acc_attempt.sum()), 1.0), 4),
+            "rounds_per_request": round(
+                self.spec_rounds / max(len(self.completed), 1), 4),
+            "drafter_ms_total": round(self.drafter_s * 1e3, 3),
+            "target_ms_total": round(self.target_s * 1e3, 3),
+            "drafter_num_pages": self.dpool.num_pages,
+            "drafter_pages_in_use": self.dpool.pages_in_use,
+            "drafter_page_bytes": page_bytes(self.drafter_model.cfg,
+                                             self.page_size),
+            "target_page_bytes": page_bytes(self.model.cfg,
+                                            self.page_size),
+        })
+        return st

@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-10 speculative-decoding session (ISSUE 7): the tiny-preset drafter
+# + k-position verify over the paged cache, on the 45m shape. The round
+# separates the TWO wins the PR claims, so each gets its own number:
+#   1. fused-sampler ablation — the SAME non-speculative paged workload
+#      with host-side full-vocab sampling (--debug_host_sampler) vs the
+#      fused in-program sampler that the engines have always shipped.
+#      The TPOT delta here prices the per-step host round-trip the fused
+#      design avoids — pure dispatch economics, no drafting involved.
+#   2. k-sweep — --speculate {2,4,8} at EQUAL HBM (drafter pages carved
+#      out of the same 48-page budget via --drafter_pages 0 auto-split),
+#      greedy first (token-identical bar), then temperature 0.8 (the
+#      rejection-sampling path under real load). accepted/dispatch and
+#      the per-position acceptance histogram land in spec_decode_stats.
+#   3. the bench A/B line — vs_paged speedup + accepted-tokens/dispatch
+#      in one JSON record (the ISSUE 7 acceptance criterion).
+# Weights are random inits (--random_init): acceptance rate with a
+# random drafter is a lower bound, and latency depends on shapes, not
+# values, so no checkpoint transfer burns window. Each run writes its own
+# obs dir so spec_decode_stats events stay separable; summarize_run.py
+# renders acceptance-per-position + drafter/target ms at the end.
+# Idempotent; reuses the round-5 session helpers (step/bench_line
+# artifact guards, SESSION_DEADLINE chokepoint via scripts/run_step.py).
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r10
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r10 speculative pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 1. fused-sampler-only ablation: identical non-speculative paged runs,
+#    host sampler vs fused. No drafter anywhere — the TPOT/TTFT delta is
+#    the per-step host round-trip the fused sampler removed.
+step ablate_host 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --num_requests 48 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --debug_host_sampler --log_dir runs/r10/ablate_host
+step ablate_fused 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --num_requests 48 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --log_dir runs/r10/ablate_fused
+
+# 2. k-sweep at equal HBM: greedy (token-identity regime) then sampled
+#    (rejection-sampling regime, temperature 0.8 / top_p 0.9). Same
+#    request distribution as the ablation so all five runs compare.
+step spec_k2 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --speculate 2 --num_requests 48 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --log_dir runs/r10/spec_k2
+step spec_k4 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --speculate 4 --num_requests 48 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --log_dir runs/r10/spec_k4
+step spec_k8 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --speculate 8 --num_requests 48 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --log_dir runs/r10/spec_k8
+step spec_k4_sampled 1200 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --model 45m --tp_size 1 --paged --slots 16 --num_pages 48 --page_size 64 --prefill_chunk 128 --speculate 4 --temperature 0.8 --decode_top_p 0.9 --num_requests 48 --rate 8 --prompt_len_min 32 --prompt_len_max 256 --max_new_tokens 128 --log_dir runs/r10/spec_k4_sampled
+
+# 3. the headline A/B line: non-speculative paged vs speculative k=4 at
+#    equal page-byte budget (vs_paged + accepted_per_dispatch in the
+#    JSON record — the ISSUE 7 acceptance criterion).
+bench_line 45mspec 1200 --serving --model 45m --tp 1 --slots 8 --serve_requests 32 --prompt_len 128 --gen_tokens 128 --page_size 64 --prefill_chunk 128 --speculate 4
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r10 speculative done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -43,6 +43,14 @@ def bench_lines(rdir):
                            f"{rec.get('kv_util_mean')}, prefix hits "
                            f"{rec.get('prefix_hit_rate')}, "
                            f"{rec.get('preemptions')} preempted")
+            if rec.get("vs_paged") is not None:
+                # speculative A/B: vs the non-speculative paged engine at
+                # equal HBM (drafter pages paid out of the same budget)
+                detail += (f", spec k={rec.get('speculate_k')}: "
+                           f"x{rec['vs_paged']} vs paged, "
+                           f"{rec.get('accepted_tokens_per_dispatch')} "
+                           f"tok/dispatch, acceptance "
+                           f"{rec.get('acceptance_rate')}")
             rows.append(f"| {tag} | {rec.get('value')} {rec.get('unit')} "
                         f"| x{rec.get('vs_baseline')} vs one-shot decode "
                         f"| {detail} |")
@@ -167,6 +175,22 @@ def serving_lines(rdir):
                     f"{rec.get('max_live')}, max interleaved prefill "
                     f"{rec.get('max_interleaved_prefill_positions')} "
                     f"positions/step")
+                continue
+            if rec.get("tag") == "spec_decode_stats":
+                # speculative round economics (serving/speculative.py)
+                by_pos = ", ".join(f"{v:.2f}" for v in
+                                   rec.get("acceptance_rate_by_position", []))
+                rows.append(
+                    f"- `{rel}` speculative: k={rec.get('speculate_k')} — "
+                    f"{rec.get('accepted_tokens_per_dispatch')} emitted "
+                    f"tokens/target dispatch (1.0 = non-speculative), "
+                    f"acceptance {rec.get('acceptance_rate')} "
+                    f"(by position: {by_pos or '-'}), "
+                    f"{rec.get('rounds_per_request')} rounds/request, "
+                    f"drafter {rec.get('drafter_ms_total')}ms vs target "
+                    f"{rec.get('target_ms_total')}ms wall, drafter pool "
+                    f"{rec.get('drafter_pages_in_use')}/"
+                    f"{rec.get('drafter_num_pages')} pages")
                 continue
             if rec.get("tag") != "serving_summary":
                 continue

@@ -87,6 +87,18 @@ CORE_LANE = {
         "test_slo_scheduler_class_ordering_and_fairness",
         "test_paged_serve_dry_run_smoke",
     ],
+    # speculative decoding (ISSUE 7): the greedy token-identity anchor at
+    # tp=2 with a disagreeing drafter, the all-accept page-boundary case,
+    # the fused-vs-host sampler pin (the bugfix satellite), the config
+    # refusals, and the --speculate CLI rot guard; the chi-square
+    # distribution test runs in the default lane but not core (~16 s)
+    "test_speculative.py": [
+        "test_spec_matches_paged_and_greedy[2-2-8]",
+        "test_spec_acceptance_boundary_at_page_boundary[7]",
+        "test_host_sampler_matches_fused[paged]",
+        "test_spec_refuses_invalid_configs",
+        "test_spec_serve_dry_run_smoke",
+    ],
     "test_sequence_parallel.py": ["test_model_sp_matches_vanilla[1-1-4]"],
     "test_overlap.py": ["test_ag_matmul_matches_gather_dot_oracle[1-2]",
                         "test_matmul_rs_matches_dot_scatter_oracle[2]",

@@ -177,6 +177,42 @@ def test_breakdown_analytic_overlapped_config_reports_comm_hidden():
     assert any("exposed collective comm" in n for n in names), names
 
 
+def test_serving_speculate_bench_emits_one_json_line():
+    """ISSUE 7 acceptance criterion: `--serving --speculate K` must run on
+    CPU and emit ONE JSON line carrying the speculative A/B — `vs_paged`
+    (speculative / plain paged at equal HBM) plus the dispatch-economics
+    fields summarize_run.py renders. With two independently random-init
+    models the greedy acceptance rate is ~0, so accepted-tokens/dispatch
+    must still floor at 1.0 (every verify emits at least the corrected
+    token) — the equal-HBM page split must show the drafter paid for."""
+    p = subprocess.run(
+        [sys.executable, "-c", (
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import bench;"
+            "bench.main(['--model','tiny','--serving','--tp','1',"
+            "'--slots','2','--serve_requests','3','--prompt_len','12',"
+            "'--gen_tokens','6','--page_size','8','--prefill_chunk','16',"
+            "'--speculate','2'])")],
+        capture_output=True, text=True, timeout=500, cwd=REPO_ROOT)
+    assert p.returncode == 0, p.stderr[-2000:]
+    lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
+    rec = json.loads(lines[0])
+    for key in ("vs_paged", "speculate_k", "accepted_tokens_per_dispatch",
+                "acceptance_rate", "acceptance_rate_by_position",
+                "spec_rounds", "drafter_ms_total", "target_ms_total",
+                "target_pages", "drafter_pages", "drafter_budget_share",
+                "paged_vs_slot", "vs_baseline"):
+        assert key in rec, (key, sorted(rec))
+    assert rec["unit"] == "tokens/sec (serving)"
+    assert rec["value"] > 0
+    assert rec["speculate_k"] == 2
+    assert rec["vs_paged"] > 0
+    assert len(rec["acceptance_rate_by_position"]) == 2
+    assert rec["accepted_tokens_per_dispatch"] >= 1.0, rec
+    assert rec["target_pages"] > 0 and rec["drafter_pages"] > 0
+
+
 def test_decode_bench_emits_one_json_line():
     """--decode measures KV-cache generation throughput; vs_baseline is the
     speedup over the reference-semantics full-recompute per-token loop
