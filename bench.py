@@ -83,20 +83,26 @@ def parse_args(argv=None):
     p.add_argument("--sequence_parallel", action="store_true",
                    help="Megatron SP over tp (reduce-scatter/all-gather "
                         "instead of all-reduce); needed for --tp_overlap")
-    p.add_argument("--tp_overlap", default="off", choices=["off", "ring"],
+    p.add_argument("--tp_overlap", default="off",
+                   choices=["off", "ring", "ring_q"],
                    help="'ring' = ring-decomposed collective matmuls for "
-                        "the SP tp collectives (ops/overlap.py); the "
-                        "breakdown/attribution then reports the comm the "
-                        "ring hides. Requires --sequence_parallel")
+                        "the SP tp collectives (ops/overlap.py); 'ring_q' "
+                        "= the same rings with int8 ppermute payloads "
+                        "(half the bf16 chunk bytes; bounds pinned in "
+                        "tests/test_quant.py); the breakdown/attribution "
+                        "then reports the comm the ring hides. Requires "
+                        "--sequence_parallel")
     p.add_argument("--dp_reduce_bucket_mb", type=float, default=0.0,
                    help="bucketed DP grad reduction: one psum per <= N-MiB "
                         "bucket (overlappable with the backward) instead "
                         "of the end-of-step whole-tree blob; 0 = off")
     p.add_argument("--dp_reduce_dtype", default="f32",
-                   choices=["f32", "bf16"],
+                   choices=["f32", "bf16", "int8"],
                    help="wire dtype for the bucketed DP reduce (bf16 "
-                        "halves the reduction bytes; f32 master "
-                        "accumulate untouched)")
+                        "halves the reduction bytes; int8 quarters them "
+                        "via the EQuARX-style block-scaled ring, "
+                        "ops/overlap.quantized_allreduce; f32 master "
+                        "accumulate untouched either way)")
     p.add_argument("--iters", type=int, default=8)
     # The product training mode this measures: train.py --steps_per_dispatch
     # runs N optimizer steps per device dispatch (lax.scan over a stacked
@@ -164,6 +170,20 @@ def parse_args(argv=None):
     p.add_argument("--prefill_chunk", type=int, default=128,
                    help="--serving: paged-engine prefill chunk (positions "
                         "per dispatch interleaved into the decode loop)")
+    p.add_argument("--kv_dtype", default="native",
+                   choices=["native", "int8"],
+                   help="--serving: paged/speculative KV-page storage "
+                        "dtype. 'int8' stores block-scaled codes "
+                        "(kv_manager.PagedKVPool) and the equal-HBM "
+                        "budget math grants the pool ~2x the pages at the "
+                        "same bytes — the record carries kv_dtype + the "
+                        "granted capacity ratio")
+    p.add_argument("--decode_weight_dtype", default="native",
+                   choices=["native", "int8"],
+                   help="--serving: weight-only int8 decode weights for "
+                        "the paged/speculative arms (dequant-on-use "
+                        "inside the decode/prefill programs; "
+                        "ops/quant.quantize_decode_params)")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="--serving: add a SPECULATIVE arm to the A/B — a "
                         "'tiny'-preset drafter proposes K tokens per round, "
@@ -178,6 +198,10 @@ def parse_args(argv=None):
         p.error("--serving excludes --decode/--breakdown")
     if args.speculate and not args.serving:
         p.error("--speculate is a --serving mode")
+    if args.kv_dtype != "native" and not args.serving:
+        p.error("--kv_dtype is a --serving knob (the paged KV pool)")
+    if args.decode_weight_dtype != "native" and not args.serving:
+        p.error("--decode_weight_dtype is a --serving knob")
     if args.remat is None:
         args.remat = "dots" if args.model == "gpt2-355m" else "false"
     if args.analytic and not args.breakdown:
@@ -186,10 +210,14 @@ def parse_args(argv=None):
         p.error("--analytic needs an explicit --remat (auto resolves "
                 "against the attached chip's memory; --analytic runs "
                 "without a backend)")
-    if args.tp_overlap == "ring" and not args.sequence_parallel:
-        p.error("--tp_overlap ring requires --sequence_parallel")
-    if args.dp_reduce_dtype == "bf16" and not args.dp_reduce_bucket_mb:
-        p.error("--dp_reduce_dtype bf16 needs --dp_reduce_bucket_mb > 0")
+    if args.tp_overlap in ("ring", "ring_q") and not args.sequence_parallel:
+        p.error(f"--tp_overlap {args.tp_overlap} requires "
+                f"--sequence_parallel (the ring decomposes the SP "
+                f"all-gather/reduce-scatter pair)")
+    if args.dp_reduce_dtype != "f32" and not args.dp_reduce_bucket_mb:
+        p.error(f"--dp_reduce_dtype {args.dp_reduce_dtype} needs "
+                f"--dp_reduce_bucket_mb > 0 (the compressed wire rides "
+                f"the bucketed reducer)")
     if args.dp_reduce_bucket_mb and args.model.endswith("-moe8"):
         p.error("--dp_reduce_bucket_mb does not compose with MoE presets "
                 "(expert grads are ep-sharded, not batch-replicated)")
@@ -217,9 +245,10 @@ def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto",
 
 def dp_reduce_kwargs(args):
     """Step-builder kwargs for the bucketed DP grad reduce flags."""
+    wire = {"bf16": jnp.bfloat16, "int8": jnp.int8}.get(
+        args.dp_reduce_dtype)
     return dict(dp_reduce_bucket_mb=args.dp_reduce_bucket_mb,
-                dp_reduce_dtype=(jnp.bfloat16
-                                 if args.dp_reduce_dtype == "bf16" else None))
+                dp_reduce_dtype=wire)
 
 
 def bucket_shape(args, cfg):
@@ -406,12 +435,27 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     # per slot — the A/B must pay paging's tail-page fragmentation out of
     # the SAME bytes, not out of extra budget. (Clamped so one worst-case
     # request still fits, else --slots 1 would refuse every submit.)
+    # --kv_dtype int8: the SAME byte budget buys ~2x the pages (int8
+    # codes + per-head-vector scales priced honestly by page_bytes) —
+    # the record carries kv_dtype + the granted capacity ratio so the
+    # r11 numbers are attributable to the knob, not to extra budget.
+    from distributed_pytorch_from_scratch_tpu.serving.kv_manager import (
+        kv_token_bytes, page_bytes)
+    kv_dtype = None if args.kv_dtype == "native" else args.kv_dtype
+    wdtype = (None if args.decode_weight_dtype == "native"
+              else args.decode_weight_dtype)
+    budget_bytes = args.slots * buf_len * kv_token_bytes(cfg)
     num_pages = max(-(-buf_len // args.page_size),
-                    (args.slots * buf_len) // args.page_size)
+                    int(budget_bytes
+                        // page_bytes(cfg, args.page_size, kv_dtype)))
+    native_pages = max(-(-buf_len // args.page_size),
+                       (args.slots * buf_len) // args.page_size)
+    kv_capacity_ratio = round(num_pages / max(native_pages, 1), 3)
     paged = PagedEngine(
         model, mesh, params, num_slots=args.serve_requests, buf_len=buf_len,
         eos_id=eos, page_size=args.page_size, num_pages=num_pages,
-        prefill_chunk=args.prefill_chunk)
+        prefill_chunk=args.prefill_chunk, kv_dtype=kv_dtype,
+        decode_weight_dtype=wdtype)
     paged_summary = run_loadgen(paged, burst())
     paged_rate = paged_summary["tokens_per_sec"]
 
@@ -426,8 +470,6 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         from distributed_pytorch_from_scratch_tpu.config import model_preset
         from distributed_pytorch_from_scratch_tpu.models.transformer import (
             Transformer as _LlamaTransformer)
-        from distributed_pytorch_from_scratch_tpu.serving.kv_manager import (
-            kv_token_bytes, page_bytes)
         from distributed_pytorch_from_scratch_tpu.serving.speculative import (
             SpeculativeEngine)
 
@@ -444,10 +486,12 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         ps = args.page_size
         d_max_pages = -(-(buf_len + k + 1) // ps)
         d_pages = args.serve_requests * d_max_pages
-        budget_bytes = args.slots * buf_len * kv_token_bytes(cfg)
-        d_bytes = d_pages * page_bytes(dcfg, ps)
+        # both pools price at THEIR storage dtype (int8 drafter pages are
+        # cheaper too — the knob shifts the whole budget split)
+        d_bytes = d_pages * page_bytes(dcfg, ps, kv_dtype)
         t_pages = max(-(-buf_len // ps),
-                      int((budget_bytes - d_bytes) // page_bytes(cfg, ps)))
+                      int((budget_bytes - d_bytes)
+                          // page_bytes(cfg, ps, kv_dtype)))
         spec_pages = {"target_pages": t_pages, "drafter_pages": d_pages,
                       "drafter_budget_share": round(
                           d_bytes / max(budget_bytes, 1), 4)}
@@ -455,7 +499,8 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             model, mesh, params, dmodel, dparams,
             num_slots=args.serve_requests, buf_len=buf_len, eos_id=eos,
             speculate_k=k, drafter_pages=d_pages, page_size=ps,
-            num_pages=t_pages, prefill_chunk=args.prefill_chunk)
+            num_pages=t_pages, prefill_chunk=args.prefill_chunk,
+            kv_dtype=kv_dtype, decode_weight_dtype=wdtype)
         spec_summary = run_loadgen(spec, burst())
 
     # (b) the PR 5 slot engine
@@ -509,7 +554,8 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
           f"{fmt(summary['ttft_ms_p95'])}ms, {args.slots} slots) vs "
           f"one-shot {oneshot_rate:.0f} tok/s "
           f"({oneshot_tokens} tokens in {oneshot_s*1000:.0f}ms); equal "
-          f"HBM budget: {num_pages} pages x {args.page_size} = "
+          f"HBM budget: {num_pages} pages x {args.page_size} "
+          f"({args.kv_dtype} KV, x{kv_capacity_ratio} vs native) = "
           f"{args.slots} slots x {buf_len}", file=sys.stderr)
     rec_value = paged_rate
     spec_rec = {}
@@ -552,6 +598,12 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         "paged_vs_slot": round(paged_rate / max(serve_rate, 1e-9), 3),
         "paged_rate": round(paged_rate, 1),
         "oneshot_rate": round(oneshot_rate, 1),
+        # quantization attribution (ISSUE 8): what the pages/weights
+        # carried and how many pages the byte budget granted vs native
+        "kv_dtype": args.kv_dtype,
+        "decode_weight_dtype": args.decode_weight_dtype,
+        "num_pages": num_pages,
+        "kv_capacity_ratio": kv_capacity_ratio,
         **spec_rec,
         "ttft_ms_p50": paged_summary["ttft_ms_p50"],
         "ttft_ms_p95": paged_summary["ttft_ms_p95"],
@@ -628,6 +680,9 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
             "value": round(report["analytic_step_ms"], 2),
             "unit": "ms/step (analytic)",
             "vs_baseline": round(report["suspects"][0]["share"], 4),
+            # r11 attribution: the wire dtypes the comm was PRICED at
+            "wire_dtype": args.dp_reduce_dtype,
+            "tp_overlap": args.tp_overlap,
             "comm": {
                 "total_ms": round(comm["comm_total_ms"], 3),
                 "hidden_ms": round(comm["comm_hidden_ms"], 3),
@@ -781,6 +836,7 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         "unit": "ms/step",
         "vs_baseline": round(step_s / multi_s, 3),
         "components": comp,
+        "wire_dtype": args.dp_reduce_dtype,
         "attribution": {
             "analytic_step_ms": round(report["analytic_step_ms"], 2),
             "chip": report["chip"],

@@ -44,6 +44,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import resolve_dtype
 from ..ops.attention import MASK_VALUE, causal_attention
 from ..ops.collectives import gather_from
+from ..ops.quant import quantize_rows
 from ..ops.ring_attention import ring_attention
 from ..ops.rope import apply_rotary, rope_tables
 from .transformer import NEG_INF, Transformer
@@ -297,7 +298,24 @@ def _decode_one(model: Transformer, params: Params, cache_k, cache_v,
     return k_new, v_new, _logits_last(model, params, x, dtype)
 
 
-def _gather_page_view(cache: jax.Array, page_tbl: jax.Array) -> jax.Array:
+def _paged_cache_write(cache, zi, dst_page, dst_off):
+    """Scatter head-vectors into a page-pool layer slice. `zi` is shaped
+    like the advanced-index result of `cache[dst_page, :, dst_off]` —
+    (b, kvh, hd) for the single-token step, (b, cw, kvh, hd) for a chunk.
+
+    A quantized pool arrives as a (codes int8, scales f32) tuple: the
+    incoming vectors quantize HERE (one symmetric scale per head-vector,
+    ops/quant.quantize_rows) and codes + scales scatter through the same
+    index maps — append-only, so no earlier position ever requantizes."""
+    if isinstance(cache, tuple):
+        codes, sc = cache
+        q, s = quantize_rows(zi)
+        return (codes.at[dst_page, :, dst_off, :].set(q),
+                sc.at[dst_page, :, dst_off].set(s))
+    return cache.at[dst_page, :, dst_off, :].set(zi.astype(cache.dtype))
+
+
+def _gather_page_view(cache, page_tbl: jax.Array, dtype) -> jax.Array:
     """Page pool layer slice (pages, kvh, page, hd) + per-row page lists
     (b, max_pages) -> the dense logical cache view (b, kvh, max_pages*page,
     hd) the attention einsums consume.
@@ -309,10 +327,20 @@ def _gather_page_view(cache: jax.Array, page_tbl: jax.Array) -> jax.Array:
     scratch page, or a COW donor's later tokens) — all finite, all masked
     to exact-zero attention weight before anything reads them, the same
     garbage-flows-only-into-garbage argument as the slot engine's free
-    rows."""
+    rows.
+
+    A quantized pool (codes, scales) dequantizes INSIDE the gather — the
+    attend math downstream is byte-for-byte the same einsum block, only
+    the view operand changed (kv_dtype='int8', ISSUE 8)."""
     b, mp = page_tbl.shape
-    _, kvh, ps, hd = cache.shape
-    view = cache[page_tbl]                      # (b, mp, kvh, ps, hd)
+    if isinstance(cache, tuple):
+        codes, sc = cache
+        _, kvh, ps, hd = codes.shape
+        view = (codes[page_tbl].astype(jnp.float32)
+                * sc[page_tbl][..., None]).astype(dtype)
+    else:
+        _, kvh, ps, hd = cache.shape
+        view = cache[page_tbl]                  # (b, mp, kvh, ps, hd)
     return view.transpose(0, 2, 1, 3, 4).reshape(b, kvh, mp * ps, hd)
 
 
@@ -349,9 +377,9 @@ def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
     def write_cache(cache, z):
         # per-row scatter into the page pool (row i writes page dst_page[i]
         # at offset dst_off[i]); duplicate scratch targets are harmless —
-        # the scratch page is never read
-        return cache.at[dst_page, :, dst_off, :].set(
-            z[:, :, 0, :].astype(cache.dtype))
+        # the scratch page is never read. Quantized pools code the vector
+        # on the way in (_paged_cache_write).
+        return _paged_cache_write(cache, z[:, :, 0, :], dst_page, dst_off)
 
     def body(x, layer_in):
         lp, k_cache, v_cache = layer_in
@@ -362,8 +390,8 @@ def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
             q, k = apply_rotary(q, k, cos, sin)
         k_cache = write_cache(k_cache, k)
         v_cache = write_cache(v_cache, v)
-        k_view = _gather_page_view(k_cache, page_tbl)
-        v_view = _gather_page_view(v_cache, page_tbl)
+        k_view = _gather_page_view(k_cache, page_tbl, dtype)
+        v_view = _gather_page_view(v_cache, page_tbl, dtype)
         # identical attend block to _decode_one (same einsums, same mask,
         # same f32 scores) — only the cache OPERAND is gathered, not sliced
         kvh = model.num_local_kv_heads
@@ -427,9 +455,10 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
 
     def write_cache(cache, z):
         # z: (b, kvh, cw, hd) -> scatter token i of row r to
-        # cache[dst_page[r, i], :, dst_off[r, i], :]
-        return cache.at[dst_page, :, dst_off, :].set(
-            z.transpose(0, 2, 1, 3).astype(cache.dtype))
+        # cache[dst_page[r, i], :, dst_off[r, i], :] (quantized pools code
+        # each head-vector on the way in)
+        return _paged_cache_write(cache, z.transpose(0, 2, 1, 3),
+                                  dst_page, dst_off)
 
     def body(x, layer_in):
         lp, k_cache, v_cache = layer_in
@@ -440,8 +469,8 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
             q, k = apply_rotary(q, k, cos, sin)
         k_cache = write_cache(k_cache, k)
         v_cache = write_cache(v_cache, v)
-        k_view = _gather_page_view(k_cache, page_tbl)
-        v_view = _gather_page_view(v_cache, page_tbl)
+        k_view = _gather_page_view(k_cache, page_tbl, dtype)
+        v_view = _gather_page_view(v_cache, page_tbl, dtype)
         kvh = model.num_local_kv_heads
         g = model.num_local_heads // kvh
         hd = model.cfg.head_dim

@@ -266,7 +266,7 @@ class GPT2Transformer:
         # ring overlap: the sublayer gather never materialises — the fused
         # ring collective matmul consumes the seq-sharded activation (same
         # contract as Transformer._layer_body)
-        ring_ov = sp and self.tp_overlap == "ring"
+        ring_ov = sp and self.tp_overlap in ("ring", "ring_q")
         maybe_gather = ((lambda z: gather_from(z, "tp", tiled_axis=-2))
                         if sp and not ring_ov else (lambda z: z))
         in_layout = ("seq_sharded" if ring_ov
@@ -279,7 +279,8 @@ class GPT2Transformer:
             y = maybe_gather(m["ln1"].apply(lp["ln1"], x))
             if ring_ov:
                 q, k, v = apply_column_ring_fused(
-                    (lp["wq"], lp["wk"], lp["wv"]), y, dtype)
+                    (lp["wq"], lp["wk"], lp["wv"]), y, dtype,
+                    quantized=self.tp_overlap == "ring_q")
             else:
                 q = m["wq"].apply(lp["wq"], y, dtype, input_layout=in_layout)
                 k = m["wk"].apply(lp["wk"], y, dtype, input_layout=in_layout)
@@ -393,11 +394,12 @@ class GPT2Transformer:
         x = self.final_norm.apply(params["norm"], x)
         # tied head: local logits against this shard's embedding rows
         w = params["embedding"]["weight"].astype(dtype)  # (vp/tp, d)
-        if sp and self.tp_overlap == "ring":
+        if sp and self.tp_overlap in ("ring", "ring_q"):
             # ring collective matmul for the tied head too: the gather's
             # hops hide under the per-chunk logits dots, and the VJP's
             # reverse ring reduce-scatters the head's input cotangent
-            logits = ag_matmul(x.astype(dtype), (w.T,), "tp")[0]
+            logits = ag_matmul(x.astype(dtype), (w.T,), "tp",
+                               self.tp_overlap == "ring_q")[0]
         else:
             if sp:
                 # the tied head consumes full-sequence activations; the

@@ -143,19 +143,20 @@ def validate_t_real(attn_t_real, cp_size: int, num_experts: int = 0) -> None:
 def validate_tp_overlap(tp_overlap: str, sequence_parallel: bool,
                         num_experts: int = 0) -> None:
     """tp_overlap construction checks shared by both model families."""
-    if tp_overlap not in ("off", "ring"):
-        raise ValueError(f"tp_overlap must be 'off' or 'ring', got "
-                         f"{tp_overlap!r}")
-    if tp_overlap == "ring" and not sequence_parallel:
+    if tp_overlap not in ("off", "ring", "ring_q"):
+        raise ValueError(f"tp_overlap must be 'off', 'ring' or 'ring_q', "
+                         f"got {tp_overlap!r}")
+    if tp_overlap in ("ring", "ring_q") and not sequence_parallel:
         raise ValueError(
-            "tp_overlap='ring' requires sequence_parallel: the ring "
-            "decomposes the SP all-gather/reduce-scatter pair; the non-SP "
-            "path's monolithic all-reduce has no chunk schedule to overlap")
-    if tp_overlap == "ring" and num_experts:
+            f"tp_overlap={tp_overlap!r} requires sequence_parallel: the "
+            "ring decomposes the SP all-gather/reduce-scatter pair; the "
+            "non-SP path's monolithic all-reduce has no chunk schedule to "
+            "overlap (or quantize per hop)")
+    if tp_overlap in ("ring", "ring_q") and num_experts:
         raise ValueError(
-            "tp_overlap='ring' does not compose with MoE yet: the router "
-            "consumes the full-token gather that the ring collective "
-            "matmul deliberately never materialises")
+            f"tp_overlap={tp_overlap!r} does not compose with MoE yet: "
+            "the router consumes the full-token gather that the ring "
+            "collective matmul deliberately never materialises")
 
 
 def remat_wrap(layer_fn, remat, static_argnums=()):
@@ -517,7 +518,8 @@ class Transformer:
         # seq-sharded activation directly, and its custom VJP sums the
         # fan-out cotangents on one reverse ring (the same one-psum_scatter
         # -per-sublayer traffic as the shared gather's transpose).
-        ring_ov = sp and self.tp_overlap == "ring"
+        ring_ov = sp and self.tp_overlap in ("ring", "ring_q")
+        ring_quant = self.tp_overlap == "ring_q"
         # Otherwise gather the normed activation ONCE per sublayer and share
         # it between the projections (wq/wk/wv, gate/up): the fan-out
         # cotangents sum at the single gather, whose transpose is one
@@ -536,7 +538,8 @@ class Transformer:
             if ring_ov:
                 q, k, v = apply_column_ring_fused(
                     (layer_params["wq"], layer_params["wk"],
-                     layer_params["wv"]), y, dtype)
+                     layer_params["wv"]), y, dtype,
+                    quantized=ring_quant)
             else:
                 q = m["wq"].apply(layer_params["wq"], y, dtype,
                                   input_layout=in_layout)
@@ -584,7 +587,7 @@ class Transformer:
             if ring_ov:
                 g, u = apply_column_ring_fused(
                     (layer_params["gate_proj"], layer_params["up_proj"]),
-                    y, dtype)
+                    y, dtype, quantized=ring_quant)
             else:
                 g = m["gate_proj"].apply(layer_params["gate_proj"], y, dtype,
                                          input_layout=in_layout)
@@ -846,7 +849,8 @@ class Transformer:
         # rings run in full, burning bubble FLOPs whose outputs are
         # structurally discarded).
         ring_cp = (self.cp_size > 1 and self.cp_impl == "ring") or (
-            self.sequence_parallel and self.tp_overlap == "ring")
+            self.sequence_parallel
+            and self.tp_overlap in ("ring", "ring_q"))
 
         if self.pp_schedule == "interleaved":
             return self._pipeline_interleaved(

@@ -251,10 +251,16 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
 
     * tp act collectives, tp_overlap='ring' — hidden up to the ms of the
       matmul sharing the ring (ag_matmul/matmul_rs overlap exactly that
-      pair); 'off' — the monolithic collective serialises fully.
+      pair); 'ring_q' additionally HALVES the priced chunk bytes (int8
+      codes + per-row scales replace the bf16 payload); 'off' — the
+      monolithic collective serialises fully.
     * DP grad reduce, dp_bucket_mb > 0 — buckets issue during the
       backward, hidden up to the backward's compute ms; 0 — the
-      end-of-step blob is fully exposed. bf16 wire halves its bytes.
+      end-of-step blob is fully exposed. The WIRE dtype prices the bytes:
+      bf16 halves them, int8 quarters them (the quantized ring's scale
+      overhead, 4/WIRE_GROUP < 1%, is deliberately ignored) — a record
+      that kept pricing the compute dtype would silently misreport the
+      quantized wire as hidden/exposed ms it no longer spends.
 
     `phase_ms` (name -> analytic ms from `analytic_phases`) supplies the
     overlap budgets; computed here when omitted.
@@ -289,13 +295,18 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
             "hidden_ms": hidden, "exposed_ms": total - hidden, "note": note})
 
     if tp > 1:
-        ring = tp_overlap == "ring"
-        shard = (tp - 1) / tp * act     # ag / reduce-scatter wire bytes
-        ar = 2 * (tp - 1) / tp * act    # all-reduce wire bytes
+        ring = tp_overlap in ("ring", "ring_q")
+        # ring_q: int8 codes on every hop — half the bf16 activation
+        # bytes (per-row scales add 4/head_dim-ish; ignored like the DP
+        # wire's group scales)
+        wire_scale = 0.5 if tp_overlap == "ring_q" else 1.0
+        shard = (tp - 1) / tp * act * wire_scale  # ag / rs wire bytes
+        ar = 2 * (tp - 1) / tp * act    # all-reduce wire bytes (non-ring)
         hops = tp - 1
         # budgets: the matmul each collective's ring is fused with (fwd),
         # and its ~2x backward counterpart for the conjugate direction
         fwd_note = ("ring: hops hide under the partial dots"
+                    + (", int8 payloads" if tp_overlap == "ring_q" else "")
                     if ring else "monolithic: fully exposed")
         if sp:
             # ring-mode counts follow `ring_chunk_bytes`'s chunk schedule:
@@ -330,7 +341,8 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
 
     if dp > 1:
         P_count = cfg.num_params()
-        wire_itemsize = 2 if dp_reduce_dtype in ("bf16", "bfloat16") else 4
+        wire_itemsize = {"bf16": 2, "bfloat16": 2,
+                         "int8": 1}.get(dp_reduce_dtype, 4)
         nbytes = 2 * (dp - 1) / dp * P_count * wire_itemsize
         bucketed = dp_bucket_mb > 0
         budget = phase_ms.get("backward", 0.0) if bucketed else 0.0
@@ -351,7 +363,12 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
                     "calibrated": bool(measured_allreduce_us)},
             "config": {"tp": tp, "sp": sp, "tp_overlap": tp_overlap,
                        "dp": dp, "dp_bucket_mb": dp_bucket_mb,
-                       "dp_reduce_dtype": dp_reduce_dtype}}
+                       "dp_reduce_dtype": dp_reduce_dtype,
+                       # the attributable wire dtypes (ISSUE 8): what the
+                       # DP reduce and the tp ring payloads actually carry
+                       "wire_dtype": dp_reduce_dtype,
+                       "tp_wire_dtype": ("int8" if tp_overlap == "ring_q"
+                                         else "bf16")}}
 
 
 def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,

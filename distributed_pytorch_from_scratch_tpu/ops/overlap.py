@@ -38,10 +38,27 @@ rank (r + n-1-s) mod n.
   blob: leaves are raveled + concatenated into <= bucket_mb buckets and
   each bucket issues its own psum the moment its last cotangent exists in
   the dataflow, so XLA can interleave the reductions with the remaining
-  backward compute. `reduce_dtype='bfloat16'` is the EQuARX-style
+  backward compute. `reduce_dtype=jnp.bfloat16` is the EQuARX-style
   compressed variant (arXiv:2506.17615): the WIRE carries bf16, the
   optimizer's f32 master accumulate is untouched (grads are cast back to
-  f32 after the reduce; no stochastic rounding).
+  f32 after the reduce; no stochastic rounding). `reduce_dtype=jnp.int8`
+  compresses further: `lax.psum` cannot express the per-hop requantization
+  a block-scaled int8 all-reduce needs, so the bucket routes through
+  `_quantized_allreduce` — a hand-rolled reduce-scatter + all-gather ring
+  (the EQuARX schedule itself) whose every hop carries int8 codes plus one
+  f32 scale per `quant.WIRE_GROUP` elements (<1% overhead), quarter the
+  f32 wire bytes; the accumulate between hops stays f32 on-rank.
+
+* `ag_matmul(..., quantized=True)` / `matmul_rs(..., quantized=True)` —
+  the `tp_overlap='ring_q'` variants: the SAME ring schedules, but every
+  ppermute payload is int8 codes + per-token-row scales. GATHER rings
+  (ag forward, both bwd re-gather rings) quantize ONCE at the chunk's
+  origin rank — error is one rounding regardless of ring size — while
+  REDUCE rings (rs forward, ag's dx ring) requantize the partial
+  accumulator each hop (error grows ~linearly in n; bounds pinned in
+  tests/test_quant.py). The matmuls consume dequantized operands at the
+  original dtype, so MXU accumulate precision is unchanged.
+  quantized=False stays bit-identical to the pre-quantization paths.
 
 All ops MUST run inside `shard_map` code partitioned over `axis`.
 """
@@ -56,10 +73,23 @@ import jax.numpy as jnp
 from jax import lax
 
 from .collectives import ring_permute
+from .quant import (WIRE_GROUP, dequantize_groups, dequantize_rows,
+                    quantize_groups, quantize_rows)
 
 
 def _axis_size(axis: str) -> int:
     return lax.axis_size(axis)  # static int: mesh shape is trace-time known
+
+
+def _ring_hop_q(z: jax.Array, axis: str, dtype):
+    """One quantized ring hop of a full-precision payload: quantize to
+    int8 + per-row scales, ppermute BOTH (codes and scales travel
+    together), dequantize on arrival. The reduce-ring building block —
+    each call adds one rounding to the circulating accumulator."""
+    q, sc = quantize_rows(z)
+    q = ring_permute(q, axis, shift=1)
+    sc = ring_permute(sc, axis, shift=1)
+    return dequantize_rows(q, sc, dtype)
 
 
 def _check_2d(name: str, x: jax.Array) -> None:
@@ -81,30 +111,47 @@ def _slot_update(a: jax.Array, upd: jax.Array, slot: jax.Array,
 # --------------------------------------------------------------- ag_matmul --
 
 def _ag_matmul_impl(x: jax.Array, ws: Tuple[jax.Array, ...],
-                    axis: str) -> Tuple[jax.Array, ...]:
+                    axis: str, quantized: bool) -> Tuple[jax.Array, ...]:
     """Ring all-gather-matmul forward: x (..., t/n, d) seq-sharded over
     `axis`, each w (d, o_local) -> each y (..., t, o_local), equal to
-    `all_gather(x, axis, tiled over -2) @ w` up to summation order."""
+    `all_gather(x, axis, tiled over -2) @ w` up to summation order.
+
+    quantized=True: the chunk is quantized ONCE here at its origin and the
+    int8 codes + per-row scales circulate instead of the full-precision
+    payload; every rank (the origin included, for cross-rank consistency)
+    dequantizes before its dots — the output equals the monolithic path
+    applied to dq(q(x)), one rounding per element total."""
     n = _axis_size(axis)
     idx = lax.axis_index(axis)
     tl = x.shape[-2]
     outs = [jnp.zeros((*x.shape[:-2], tl * n, w.shape[-1]), x.dtype)
             for w in ws]
-    chunk = x
+    if quantized:
+        q, sc = quantize_rows(x)
+        chunk = dequantize_rows(q, sc, x.dtype)
+    else:
+        chunk = x
     for s in range(n):
         # issue the hop FIRST: it has no dependency on this step's dots, so
         # the scheduler overlaps the wire with the MXU work
-        nxt = ring_permute(chunk, axis, shift=1) if s < n - 1 else None
+        if s < n - 1:
+            if quantized:
+                q = ring_permute(q, axis, shift=1)
+                sc = ring_permute(sc, axis, shift=1)
+            else:
+                nxt = ring_permute(chunk, axis, shift=1)
         slot = jnp.mod(idx - s, n)  # origin rank of the chunk in hand
         for j, w in enumerate(ws):
             outs[j] = _slot_update(outs[j], chunk @ w, slot, tl)
-        chunk = nxt
+        if s < n - 1:
+            chunk = (dequantize_rows(q, sc, x.dtype) if quantized else nxt)
     return tuple(outs)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def ag_matmul(x: jax.Array, ws: Tuple[jax.Array, ...],
-              axis: str = "tp") -> Tuple[jax.Array, ...]:
+              axis: str = "tp",
+              quantized: bool = False) -> Tuple[jax.Array, ...]:
     """Fused all-gather-matmul over a ring.
 
     `x` is this rank's (..., t/n, d) sequence chunk; `ws` a tuple of local
@@ -113,6 +160,11 @@ def ag_matmul(x: jax.Array, ws: Tuple[jax.Array, ...],
     (..., t, o_j) full-sequence outputs. The custom VJP reduces the fan-out
     cotangents on one reverse ring (dx) while re-gathering x chunks for the
     weight grads on a second — both overlapped the same way as the forward.
+
+    `quantized` (tp_overlap='ring_q') puts int8 codes + per-row scales on
+    every hop: the x chunks (fwd and the bwd re-gather ring) quantize once
+    at origin; the bwd dx reduce ring requantizes its accumulator per hop.
+    False is bit-identical to the unquantized ring.
     """
     _check_2d("ag_matmul", x)
     if not isinstance(ws, (tuple, list)) or not ws:
@@ -123,14 +175,14 @@ def ag_matmul(x: jax.Array, ws: Tuple[jax.Array, ...],
             raise ValueError(
                 f"ag_matmul weight shape {w.shape} does not contract with "
                 f"x feature dim {x.shape[-1]}")
-    return _ag_matmul_impl(x, tuple(ws), axis)
+    return _ag_matmul_impl(x, tuple(ws), axis, quantized)
 
 
-def _ag_matmul_fwd(x, ws, axis):
-    return _ag_matmul_impl(x, tuple(ws), axis), (x, tuple(ws))
+def _ag_matmul_fwd(x, ws, axis, quantized):
+    return _ag_matmul_impl(x, tuple(ws), axis, quantized), (x, tuple(ws))
 
 
-def _ag_matmul_bwd(axis, res, dys):
+def _ag_matmul_bwd(axis, quantized, res, dys):
     x, ws = res
     n = _axis_size(axis)
     idx = lax.axis_index(axis)
@@ -139,9 +191,20 @@ def _ag_matmul_bwd(axis, res, dys):
 
     dx_acc = None
     dws = [jnp.zeros_like(w) for w in ws]
-    chunk = x
+    if quantized:
+        # the re-gather ring circulates dq(q(x)) — the same x~ the forward
+        # consumed, quantized once at origin
+        q, sc = quantize_rows(x)
+        chunk = dequantize_rows(q, sc, x.dtype)
+    else:
+        chunk = x
     for s in range(n):
-        nxt = ring_permute(chunk, axis, shift=1) if s < n - 1 else None
+        if s < n - 1:
+            if quantized:
+                q = ring_permute(q, axis, shift=1)
+                sc = ring_permute(sc, axis, shift=1)
+            else:
+                nxt = ring_permute(chunk, axis, shift=1)
         # dw ring: the chunk in hand originated at rank `slot`; it pairs
         # with the cotangent rows of that same slot
         slot = jnp.mod(idx - s, n)
@@ -155,9 +218,16 @@ def _ag_matmul_bwd(axis, res, dys):
                 chunk, dy_slot, axes=(bdims, bdims))
             p = _slot_slice(dy, dest, tl) @ w.T
             part = p if part is None else part + p
-        dx_acc = (part if s == 0
-                  else ring_permute(dx_acc, axis, shift=1) + part)
-        chunk = nxt
+        if s == 0:
+            dx_acc = part
+        elif quantized:
+            # reduce ring: the accumulator requantizes each hop (the only
+            # ring_q payload whose error grows with n)
+            dx_acc = _ring_hop_q(dx_acc, axis, part.dtype) + part
+        else:
+            dx_acc = ring_permute(dx_acc, axis, shift=1) + part
+        if s < n - 1:
+            chunk = (dequantize_rows(q, sc, x.dtype) if quantized else nxt)
     return dx_acc.astype(x.dtype), tuple(
         dw.astype(w.dtype) for dw, w in zip(dws, ws))
 
@@ -167,10 +237,15 @@ ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
 
 # --------------------------------------------------------------- matmul_rs --
 
-def _matmul_rs_impl(x: jax.Array, w: jax.Array, axis: str) -> jax.Array:
+def _matmul_rs_impl(x: jax.Array, w: jax.Array, axis: str,
+                    quantized: bool) -> jax.Array:
     """Ring matmul-reduce-scatter forward: x (..., t, f_local), w
     (f_local, o) -> (..., t/n, o), equal to
-    `psum_scatter(x @ w, axis, scatter over -2)` up to summation order."""
+    `psum_scatter(x @ w, axis, scatter over -2)` up to summation order.
+
+    quantized=True: the circulating accumulator requantizes before each
+    hop (int8 codes + per-row scales on the wire); the local partial dot
+    and the add stay at the original dtype — n-1 roundings end-to-end."""
     n = _axis_size(axis)
     idx = lax.axis_index(axis)
     tl = x.shape[-2] // n
@@ -179,12 +254,18 @@ def _matmul_rs_impl(x: jax.Array, w: jax.Array, axis: str) -> jax.Array:
         dest = jnp.mod(idx + (n - 1 - s), n)
         part = _slot_slice(x, dest, tl) @ w
         # the hop and the next step's dot are independent: wire hides
-        acc = part if s == 0 else ring_permute(acc, axis, shift=1) + part
+        if s == 0:
+            acc = part
+        elif quantized:
+            acc = _ring_hop_q(acc, axis, part.dtype) + part
+        else:
+            acc = ring_permute(acc, axis, shift=1) + part
     return acc
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
-def matmul_rs(x: jax.Array, w: jax.Array, axis: str = "tp") -> jax.Array:
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_rs(x: jax.Array, w: jax.Array, axis: str = "tp",
+              quantized: bool = False) -> jax.Array:
     """Fused matmul-reduce-scatter over a ring (the ag_matmul conjugate).
 
     `x` holds this rank's partial-product input over the FULL sequence,
@@ -192,6 +273,10 @@ def matmul_rs(x: jax.Array, w: jax.Array, axis: str = "tp") -> jax.Array:
     sequence chunk. Refuses a sequence length the ring cannot chunk evenly
     — pick a t divisible by the axis size (same constraint as
     `sequence_parallel` itself).
+
+    `quantized` (tp_overlap='ring_q'): the forward reduce ring requantizes
+    its accumulator per hop; the backward cotangent-gather ring quantizes
+    once at origin. False is bit-identical to the unquantized ring.
     """
     _check_2d("matmul_rs", x)
     n = _axis_size(axis)
@@ -203,14 +288,14 @@ def matmul_rs(x: jax.Array, w: jax.Array, axis: str = "tp") -> jax.Array:
         raise ValueError(
             f"matmul_rs weight shape {w.shape} does not contract with x "
             f"feature dim {x.shape[-1]}")
-    return _matmul_rs_impl(x, w, axis)
+    return _matmul_rs_impl(x, w, axis, quantized)
 
 
-def _matmul_rs_fwd(x, w, axis):
-    return _matmul_rs_impl(x, w, axis), (x, w)
+def _matmul_rs_fwd(x, w, axis, quantized):
+    return _matmul_rs_impl(x, w, axis, quantized), (x, w)
 
 
-def _matmul_rs_bwd(axis, res, dy):
+def _matmul_rs_bwd(axis, quantized, res, dy):
     x, w = res
     n = _axis_size(axis)
     idx = lax.axis_index(axis)
@@ -219,14 +304,26 @@ def _matmul_rs_bwd(axis, res, dy):
 
     dx = jnp.zeros_like(x)
     dw = jnp.zeros_like(w)
-    chunk = dy  # (..., t/n, o): ring-gather the cotangent chunks
+    # ring-gather the cotangent chunks; quantized mode codes dy ONCE at
+    # origin (a gather ring, like the forward ag chunks)
+    if quantized:
+        q, sc = quantize_rows(dy)
+        chunk = dequantize_rows(q, sc, dy.dtype)
+    else:
+        chunk = dy
     for s in range(n):
-        nxt = ring_permute(chunk, axis, shift=1) if s < n - 1 else None
+        if s < n - 1:
+            if quantized:
+                q = ring_permute(q, axis, shift=1)
+                sc = ring_permute(sc, axis, shift=1)
+            else:
+                nxt = ring_permute(chunk, axis, shift=1)
         slot = jnp.mod(idx - s, n)
         dx = _slot_update(dx, (chunk @ w.T).astype(x.dtype), slot, tl)
         dw = dw + jnp.tensordot(_slot_slice(x, slot, tl), chunk,
                                 axes=(bdims, bdims))
-        chunk = nxt
+        if s < n - 1:
+            chunk = (dequantize_rows(q, sc, dy.dtype) if quantized else nxt)
     return dx, dw.astype(w.dtype)
 
 
@@ -234,6 +331,76 @@ matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
 
 
 # ------------------------------------------------------ bucketed reduction --
+
+def _quantized_allreduce_axis(x: jax.Array, axis: str,
+                              group: int = WIRE_GROUP) -> jax.Array:
+    """Block-scaled int8 ring all-reduce of a flat f32 vector over ONE
+    mesh axis (the EQuARX schedule, arXiv:2506.17615).
+
+    Reduce-scatter phase: the partial sum for block j starts at rank j+1
+    and walks the +1 ring, each rank dequantizing the arriving int8
+    partial, adding its OWN f32 contribution (the master accumulate —
+    every addition happens in f32 on-rank), and requantizing for the next
+    hop; after n-1 hops rank j holds block j's full sum in f32. All-gather
+    phase: each rank quantizes its owned block ONCE and rings it around;
+    every rank — the owner included — dequantizes the same codes, so the
+    result is bit-identical across ranks (the optimizer step depends on
+    replica-identical grads). Wire bytes: 2(n-1)/n x size x 1 byte + one
+    f32 scale per `group` elements — quarter of the f32 psum ring.
+
+    Error: block j's partial is requantized n-1 times plus once in the
+    gather -> worst-case n x (group amax)/254 absolute; the bound pinned
+    in tests/test_quant.py."""
+    n = _axis_size(axis)
+    if n == 1:
+        return x
+    idx = lax.axis_index(axis)
+    size = x.shape[0]
+    chunk = -(-size // n)
+    chunk = -(-chunk // group) * group      # scale groups never straddle
+    xp = jnp.pad(x.astype(jnp.float32), (0, n * chunk - size))
+    blocks = xp.reshape(n, chunk)
+
+    def block(j):
+        return lax.dynamic_slice_in_dim(blocks, j, 1, axis=0)[0]
+
+    # -- reduce-scatter: block j's partial starts at rank j+1, so this
+    # rank SEEDS block idx-1; at step s the arriving partial is for block
+    # idx-1-s and picks up this rank's contribution before the next hop
+    send = block(jnp.mod(idx - 1, n))
+    for s in range(1, n):
+        q, sc = quantize_groups(send, group)
+        q = ring_permute(q, axis, shift=1)
+        sc = ring_permute(sc, axis, shift=1)
+        arrived = dequantize_groups(q, sc, chunk, group)
+        send = arrived + block(jnp.mod(idx - 1 - s, n))
+    own = send                               # full f32 sum of block `idx`
+
+    # -- all-gather: one quantization at the owner, n-1 hops
+    q, sc = quantize_groups(own, group)
+    out = jnp.zeros_like(blocks)
+    out = lax.dynamic_update_slice_in_dim(
+        out, dequantize_groups(q, sc, chunk, group)[None], idx, axis=0)
+    for s in range(1, n):
+        q = ring_permute(q, axis, shift=1)
+        sc = ring_permute(sc, axis, shift=1)
+        origin = jnp.mod(idx - s, n)
+        out = lax.dynamic_update_slice_in_dim(
+            out, dequantize_groups(q, sc, chunk, group)[None], origin,
+            axis=0)
+    return out.reshape(-1)[:size]
+
+
+def quantized_allreduce(x: jax.Array, axes,
+                        group: int = WIRE_GROUP) -> jax.Array:
+    """Sequential per-axis quantized all-reduces (sum over axis products
+    factors); axes of size 1 are free. The int8 reduce_dtype backend of
+    `bucketed_psum`."""
+    axes = (axes,) if isinstance(axes, str) else tuple(axes)
+    for ax in axes:
+        x = _quantized_allreduce_axis(x, ax, group)
+    return x
+
 
 def bucket_partition(sizes: Sequence[int], bucket_bytes: int,
                      itemsize: int = 4) -> "list[list[int]]":
@@ -267,7 +434,13 @@ def bucketed_psum(tree, axes, bucket_mb: float = 25.0,
     cast down before the psum and back to their original dtype after, so
     the optimizer's f32 master accumulate still sees f32 grads (EQuARX-
     style; adds one bf16 rounding per grad element plus the reduced-
-    precision accumulation across the `axes` ranks).
+    precision accumulation across the `axes` ranks). `jnp.int8` goes
+    further: each bucket routes through `quantized_allreduce` — a
+    hand-rolled reduce-scatter + all-gather ring whose hops carry int8
+    codes + per-WIRE_GROUP f32 scales (quarter the f32 bytes) while every
+    cross-rank addition happens in f32 on-rank (psum itself cannot
+    express per-hop requantization). Error bound pinned alongside the
+    bf16 one in tests/test_quant.py.
     """
     axes = (axes,) if isinstance(axes, str) else tuple(axes)
     if not axes:
@@ -286,10 +459,24 @@ def bucketed_psum(tree, axes, bucket_mb: float = 25.0,
         for group in bucket_partition([leaves[i].size for i in idxs],
                                       int(bucket_mb * 2**20), itemsize):
             buckets.append([idxs[g] for g in group])
+    int8_wire = (reduce_dtype is not None
+                 and jnp.dtype(reduce_dtype) == jnp.int8)
+
+    def leaf_pad(z: jax.Array) -> int:
+        # int8 buckets pad each leaf to a WIRE_GROUP multiple so no scale
+        # group straddles two leaves: a tiny-magnitude leaf (norm gain)
+        # concatenated after a large one would otherwise inherit the big
+        # leaf's group scale and lose all its mantissa
+        return (-z.size) % WIRE_GROUP if int8_wire else 0
+
     out = [None] * len(leaves)
     for idxs in buckets:
-        flat = jnp.concatenate([leaves[i].ravel() for i in idxs])
-        if reduce_dtype is not None:
+        flat = jnp.concatenate([
+            jnp.pad(leaves[i].ravel(), (0, leaf_pad(leaves[i])))
+            for i in idxs])
+        if int8_wire:
+            reduced = quantized_allreduce(flat, axes).astype(flat.dtype)
+        elif reduce_dtype is not None:
             reduced = lax.psum(flat.astype(reduce_dtype), axes)
             reduced = reduced.astype(flat.dtype)
         else:
@@ -298,5 +485,5 @@ def bucketed_psum(tree, axes, bucket_mb: float = 25.0,
         for i in idxs:
             n = leaves[i].size
             out[i] = reduced[off:off + n].reshape(leaves[i].shape)
-            off += n
+            off += n + leaf_pad(leaves[i])
     return jax.tree.unflatten(treedef, out)

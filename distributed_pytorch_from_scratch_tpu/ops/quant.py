@@ -1,0 +1,167 @@
+"""Symmetric int8 quantization primitives for wires and caches (ISSUE 8).
+
+One quantization rule serves the whole repo — blockwise SYMMETRIC int8:
+
+    scale = max(|x|) / 127   over a small block of elements
+    q     = round(x / scale) in [-127, 127]   (int8; -128 never produced)
+    x~    = q * scale        (dequantize)
+
+so every block's worst-case absolute error is scale/2 = amax/254, i.e.
+< 2^-7 RELATIVE to the block's own amax — the bound the wire/cache tests
+pin. All-zero blocks take scale = 1 and round-trip EXACTLY (q = 0); a
+single outlier inflates only its own block's scale, which is why every
+consumer quantizes in small blocks (per token-row, per page slot, per
+wire group) instead of per tensor.
+
+Consumers:
+
+* `ops/overlap.bucketed_psum(reduce_dtype=jnp.int8)` — the EQuARX-style
+  quantized DP-reduce wire (per-`WIRE_GROUP` scales travel with each ring
+  hop; f32 master accumulate never leaves the host rank).
+* `ops/overlap.ag_matmul/matmul_rs(quantized=True)` — `tp_overlap=
+  'ring_q'`: ppermute payloads carry int8 codes + scales (gather rings
+  quantize ONCE at the origin; reduce rings requantize per hop).
+* `serving/kv_manager.PagedKVPool(kv_dtype='int8')` — KV pages stored as
+  int8 codes with one f32 scale per (layer, page, head, position);
+  `models/decode` quantizes on write and dequantizes the gathered view.
+* engine `decode_weight_dtype='int8'` — weight-only decode quantization:
+  `quantize_decode_params` rewrites every >=2-D float param leaf into
+  {int8 codes, per-output-channel scale} host-side, and the compiled
+  decode/prefill programs call `dequantize_decode_params` first
+  (dequant-on-use; XLA fuses the convert into the consuming matmul).
+
+Everything here is shape-polymorphic jnp math — no collectives, no mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# int8 code range: symmetric +-127 (never -128, so negation round-trips)
+QMAX = 127.0
+
+# elements per scale on the quantized DP-reduce wire: small enough that a
+# single outlier poisons <= 512 elements, large enough that the f32 scale
+# overhead is 4/512 < 1% of the int8 payload
+WIRE_GROUP = 512
+
+
+def _safe_scale(amax: jax.Array) -> jax.Array:
+    """amax -> f32 scale; all-zero blocks take 1.0 (q = 0 exactly)."""
+    return jnp.where(amax > 0, amax / QMAX, 1.0).astype(jnp.float32)
+
+
+def quantize_rows(x: jax.Array):
+    """Blockwise int8 over the LAST dim: x (..., d) -> (codes int8 (..., d),
+    scales f32 (...,)). The per-token-row rule the ring payloads and KV
+    pages use (one scale per head-vector / feature-row)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = _safe_scale(amax)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -QMAX, QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of `quantize_rows`."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def quantize_groups(x: jax.Array, group: int = WIRE_GROUP):
+    """Flat 1-D x -> (codes int8 (n,), scales f32 (n/group,)). Pads to a
+    group multiple internally; caller keeps the original length. The
+    DP-reduce wire rule (`bucketed_psum` int8 path)."""
+    n = x.shape[0]
+    pad = (-n) % group
+    xp = jnp.pad(x.astype(jnp.float32), (0, pad)).reshape(-1, group)
+    q, scale = quantize_rows(xp)
+    return q.reshape(-1)[:n], scale
+
+
+def dequantize_groups(q: jax.Array, scale: jax.Array, n: int,
+                      group: int = WIRE_GROUP, dtype=jnp.float32):
+    """Inverse of `quantize_groups` (n = original length)."""
+    pad = (-q.shape[0]) % group
+    qp = jnp.pad(q, (0, pad)).reshape(-1, group)
+    return dequantize_rows(qp, scale, dtype).reshape(-1)[:n]
+
+
+# ------------------------------------------------- decode-weight quant --
+
+def _is_qleaf(d: Any) -> bool:
+    return isinstance(d, dict) and "qweight" in d
+
+
+def quantize_weight(w: jax.Array):
+    """Per-output-channel int8: scale over the CONTRACTION dim (axis -2 —
+    weights are (..., idim, odim), stacked layers (L, idim, odim)), so
+    y = x @ dq(w) sees one scale per output column. Returns
+    {"qweight": int8 same-shape, "scale": f32 with dim -2 == 1}."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True)
+    scale = _safe_scale(amax)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                 -QMAX, QMAX).astype(jnp.int8)
+    return {"qweight": q, "scale": scale}
+
+
+def dequantize_weight(leaf, dtype=jnp.float32) -> jax.Array:
+    return (leaf["qweight"].astype(jnp.float32)
+            * leaf["scale"]).astype(dtype)
+
+
+def _quantizable(leaf) -> bool:
+    """>=2-D float leaves only: matmul weights, embeddings, stacked layer
+    params. 1-D norm gains / biases stay f32 (tiny, precision-critical)."""
+    return (hasattr(leaf, "ndim") and leaf.ndim >= 2
+            and jnp.issubdtype(leaf.dtype, jnp.floating))
+
+
+def _scale_spec(spec: P, ndim: int) -> P:
+    """PartitionSpec for a weight's per-channel scale: the weight spec
+    padded to its rank with the contraction-dim (axis -2) entry dropped —
+    the scale broadcasts over that dim (size 1)."""
+    ent = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    return P(*ent[:-2], None, ent[-1])
+
+
+def quantize_decode_params(params, specs, mesh=None):
+    """Host-side weight-only quantization of a full param tree.
+
+    Every >=2-D float leaf becomes {"qweight", "scale"}; everything else
+    (biases, norm gains) passes through untouched. Returns (qparams,
+    qspecs); when `mesh` is given the quantized tree is device_put with
+    the derived shardings (codes shard exactly like the weight; scales
+    like the weight minus its contraction dim)."""
+    flat_p, treedef = jax.tree.flatten(params)
+    flat_s = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    assert len(flat_p) == len(flat_s), (len(flat_p), len(flat_s))
+    out_p, out_s = [], []
+    for leaf, spec in zip(flat_p, flat_s):
+        if _quantizable(leaf):
+            out_p.append(quantize_weight(leaf))
+            out_s.append({"qweight": spec,
+                          "scale": _scale_spec(spec, leaf.ndim)})
+        else:
+            out_p.append(leaf)
+            out_s.append(spec)
+    qparams = jax.tree.unflatten(treedef, out_p)
+    qspecs = jax.tree.unflatten(treedef, out_s)
+    if mesh is not None:
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), qspecs,
+                                 is_leaf=lambda x: isinstance(x, P))
+        qparams = jax.device_put(qparams, shardings)
+    return qparams, qspecs
+
+
+def dequantize_decode_params(qparams, dtype=jnp.float32):
+    """Inside-program inverse: {"qweight","scale"} leaves -> dense weights
+    at `dtype` (per-shard — call under shard_map; codes and scales shard
+    consistently, so the dequant is purely local)."""
+    return jax.tree.map(
+        lambda leaf: dequantize_weight(leaf, dtype) if _is_qleaf(leaf)
+        else leaf,
+        qparams, is_leaf=_is_qleaf)

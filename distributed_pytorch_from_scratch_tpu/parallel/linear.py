@@ -40,7 +40,7 @@ from ..ops.overlap import ag_matmul, matmul_rs
 
 Params = Dict[str, Any]
 
-OVERLAP_MODES = ("off", "ring")
+OVERLAP_MODES = ("off", "ring", "ring_q")
 
 
 def _check_overlap(overlap: str) -> None:
@@ -73,7 +73,9 @@ class ColumnParallelLinear:
     axis: str = "tp"
     # 'ring' decomposes the sequence-parallel input all-gather into a ring
     # collective matmul (ops/overlap.ag_matmul): each ppermute hop overlaps
-    # with the partial dot of the chunk already in hand. Only the
+    # with the partial dot of the chunk already in hand. 'ring_q' is the
+    # same ring with int8 codes + per-row scales on every hop (half the
+    # bf16 wire bytes; bounds pinned in tests/test_quant.py). Only the
     # input_layout='seq_sharded' path changes; 'off' stays bit-identical.
     overlap: str = "off"
 
@@ -96,11 +98,13 @@ class ColumnParallelLinear:
               compute_dtype: jnp.dtype = jnp.float32,
               input_layout: str = "replicated") -> jax.Array:
         w = params["weight"].astype(compute_dtype)      # local (idim, odim/n)
-        if input_layout == "seq_sharded" and self.overlap == "ring":
+        if input_layout == "seq_sharded" and self.overlap != "off":
             # ring collective matmul: the gather's ppermute hops hide under
             # the per-chunk partial dots; the custom VJP rings the backward
-            # too (matmul_rs for dx, a re-gather ring for dw).
-            y = ag_matmul(x.astype(compute_dtype), (w,), self.axis)[0]
+            # too (matmul_rs for dx, a re-gather ring for dw). 'ring_q'
+            # quantizes every hop's payload (ops/overlap.py).
+            y = ag_matmul(x.astype(compute_dtype), (w,), self.axis,
+                          self.overlap == "ring_q")[0]
             return self._epilogue(params, y, compute_dtype)
         if input_layout == "replicated":
             x = copy_to(x, self.axis)                   # bwd: all-reduce input grads
@@ -147,7 +151,9 @@ class RowParallelLinear:
     # 'ring' decomposes the sequence-parallel output reduce-scatter into a
     # ring collective matmul (ops/overlap.matmul_rs): partial dots feed the
     # reduce ring chunk by chunk instead of blocking on one psum_scatter.
-    # Only the output_layout='seq_sharded' path changes; 'off' is today's.
+    # 'ring_q' additionally requantizes the circulating accumulator to
+    # int8 before each hop. Only the output_layout='seq_sharded' path
+    # changes; 'off' is today's.
     overlap: str = "off"
 
     def __post_init__(self):
@@ -171,10 +177,11 @@ class RowParallelLinear:
         if self.split_input:
             x = split_to(x, self.axis)                  # (.., idim) -> (.., idim/n)
         w = params["weight"].astype(compute_dtype)      # local (idim/n, odim)
-        if output_layout == "seq_sharded" and self.overlap == "ring":
+        if output_layout == "seq_sharded" and self.overlap != "off":
             # ring collective matmul: per-chunk partial dots interleave with
             # the reduce ring's hops instead of one blocking psum_scatter
-            y = matmul_rs(x.astype(compute_dtype), w, self.axis)
+            y = matmul_rs(x.astype(compute_dtype), w, self.axis,
+                          self.overlap == "ring_q")
         elif output_layout == "replicated":
             y = reduce_from(x.astype(compute_dtype) @ w, self.axis)
         elif output_layout == "seq_sharded":
@@ -192,7 +199,7 @@ class RowParallelLinear:
 
 
 def apply_column_ring_fused(params_list, x: jax.Array, compute_dtype,
-                            axis: str = "tp"):
+                            axis: str = "tp", quantized: bool = False):
     """Several column-parallel projections of ONE seq-sharded input on ONE
     shared ring (wq/wk/wv, gate/up): the fused ag_matmul moves exactly the
     bytes of the single shared all-gather the monolithic path uses, and the
@@ -202,9 +209,11 @@ def apply_column_ring_fused(params_list, x: jax.Array, compute_dtype,
     `params_list` is a sequence of ColumnParallelLinear param dicts (the
     layers must all be gather_output=False, which the model pattern
     guarantees). Returns one local (.., t, odim/n) output per entry.
+    `quantized` (tp_overlap='ring_q') puts int8 payloads on the shared
+    ring — still one quantization per chunk, however many weights ride it.
     """
     ws = tuple(p["weight"].astype(compute_dtype) for p in params_list)
-    ys = ag_matmul(x.astype(compute_dtype), ws, axis)
+    ys = ag_matmul(x.astype(compute_dtype), ws, axis, quantized)
     out = []
     for p, y in zip(params_list, ys):
         if "bias" in p:

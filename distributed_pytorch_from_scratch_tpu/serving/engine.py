@@ -49,8 +49,33 @@ from ..models.decode import (_decode_one, _paged_decode_one,
                              host_sample_tokens, make_token_sampler,
                              rope_tables)
 from ..config import resolve_dtype
+from ..ops.quant import dequantize_decode_params, quantize_decode_params
 from .kv_manager import (KVCachePool, POOL_SPEC, PagedKVPool, PoolExhausted)
 from .scheduler import FIFOScheduler, SLOScheduler
+
+
+def _setup_decode_weights(engine, model, mesh, params, decode_weight_dtype):
+    """Shared weight-dtype plumbing for every engine: `engine._params_in`
+    is what the compiled programs take (int8 codes + per-output-channel
+    scales when decode_weight_dtype='int8'), `engine._pspec` its matching
+    spec tree, and `engine._deq(params)` the inside-program prologue that
+    hands the decode/prefill lowerings ordinary dense weights (dequant-on-
+    use: XLA fuses the int8->f32 convert into the consuming matmul, so
+    the weights' HBM traffic — the decode latency floor at small models —
+    is int8). Sampling, caches, and every token produced stay governed by
+    the engines' usual contracts; weight rounding shifts logits by a
+    bounded amount (pinned in tests/test_quant.py)."""
+    if decode_weight_dtype in (None, "native"):
+        engine._params_in = params
+        engine._pspec = model.specs()
+        engine._deq = lambda p: p
+    elif decode_weight_dtype in ("int8", jnp.int8):
+        engine._params_in, engine._pspec = quantize_decode_params(
+            params, model.specs(), mesh)
+        engine._deq = dequantize_decode_params
+    else:
+        raise ValueError(f"decode_weight_dtype must be None/'native'/"
+                         f"'int8', got {decode_weight_dtype!r}")
 
 
 @dataclass
@@ -158,6 +183,7 @@ class ContinuousBatchingEngine:
                  top_k: int = 0, top_p: float = 0.0,
                  prefill_bucket: int = 64, max_prefill_batch: int = 4,
                  max_queue: int = 0, debug_host_sampler: bool = False,
+                 decode_weight_dtype=None,
                  tracer=None, writer=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
@@ -193,6 +219,7 @@ class ContinuousBatchingEngine:
         self._debug_host_sampler = debug_host_sampler
         self._sample = make_token_sampler(model, temperature=temperature,
                                           top_k=top_k, top_p=top_p)
+        _setup_decode_weights(self, model, mesh, params, decode_weight_dtype)
         self.pool = KVCachePool(model, mesh, num_slots, buf_len)
         self.scheduler = FIFOScheduler(buf_len, prefill_bucket=prefill_bucket,
                                        max_queue=max_queue, clock=clock)
@@ -224,6 +251,7 @@ class ContinuousBatchingEngine:
         debug = self._debug_host_sampler
 
         def shard_fn(params, pool_k, pool_v, tokens, pos, seeds):
+            params = self._deq(params)   # int8 decode weights dequant here
             cos_t, sin_t = self._tables()
             pool_k, pool_v, logits = _decode_one(
                 model, params, pool_k, pool_v, tokens, pos, buf_len,
@@ -238,7 +266,7 @@ class ContinuousBatchingEngine:
 
         fn = jax.shard_map(
             shard_fn, mesh=self.mesh,
-            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None), P(None),
+            in_specs=(self._pspec, POOL_SPEC, POOL_SPEC, P(None), P(None),
                       P(None)),
             out_specs=(POOL_SPEC, POOL_SPEC,
                        P(None, "tp") if debug else P(None)))
@@ -248,6 +276,7 @@ class ContinuousBatchingEngine:
         model, dtype = self.model, self._dtype
 
         def shard_fn(params, pool_k, pool_v, buf, prompt_len, slots, seeds):
+            params = self._deq(params)
             cos_t, sin_t = self._tables()
             ks, vs, logits = _prefill(model, params, buf, prompt_len,
                                       cos_t, sin_t, dtype)
@@ -263,7 +292,7 @@ class ContinuousBatchingEngine:
 
         fn = jax.shard_map(
             shard_fn, mesh=self.mesh,
-            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None, None),
+            in_specs=(self._pspec, POOL_SPEC, POOL_SPEC, P(None, None),
                       P(None), P(None), P(None)),
             out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
         return jax.jit(fn, donate_argnums=(1, 2))
@@ -347,7 +376,7 @@ class ContinuousBatchingEngine:
             self._prefill_fns[key] = self._build_prefill(nb, width)
         with self._span("prefill", rows=len(ready), nb=nb, width=width):
             ks, vs, tok = self._prefill_fns[key](
-                self.params, self.pool.ks, self.pool.vs, jnp.asarray(buf),
+                self._params_in, self.pool.ks, self.pool.vs, jnp.asarray(buf),
                 jnp.asarray(plens), jnp.asarray(slot_idx),
                 jnp.asarray(seeds))
             self.pool.adopt(ks, vs)
@@ -372,7 +401,7 @@ class ContinuousBatchingEngine:
     def _decode(self, done: List[Request]) -> None:
         with self._span("decode_step", live=len(self._slot_req)):
             ks, vs, tok = self._step_fn(
-                self.params, self.pool.ks, self.pool.vs,
+                self._params_in, self.pool.ks, self.pool.vs,
                 jnp.asarray(self._tokens), jnp.asarray(self._pos),
                 jnp.asarray(self._seeds))
             self.pool.adopt(ks, vs)
@@ -499,6 +528,7 @@ class PagedEngine:
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  slo_classes=None, default_class: str = "standard",
                  max_queue: int = 0, debug_host_sampler: bool = False,
+                 kv_dtype=None, decode_weight_dtype=None,
                  tracer=None, writer=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
@@ -544,7 +574,12 @@ class PagedEngine:
         self._debug_host_sampler = debug_host_sampler
         self._sample = make_token_sampler(model, temperature=temperature,
                                           top_k=top_k, top_p=top_p)
-        self.pool = PagedKVPool(model, mesh, num_pages, page_size)
+        _setup_decode_weights(self, model, mesh, params, decode_weight_dtype)
+        # int8 pages: codes + per-head-vector scales through the SAME
+        # lease/COW/free accounting (kv_manager.PagedKVPool docstring)
+        self.kv_dtype = kv_dtype
+        self.pool = PagedKVPool(model, mesh, num_pages, page_size,
+                                kv_dtype=kv_dtype)
         self.scheduler = SLOScheduler(self.buf_len, classes=slo_classes,
                                       default_class=default_class,
                                       max_queue=max_queue, clock=clock)
@@ -584,8 +619,10 @@ class PagedEngine:
     def _build_step(self):
         model, ps, dtype = self.model, self.page_size, self._dtype
         debug = self._debug_host_sampler
+        pspec = self.pool.pspec   # plain POOL_SPEC, or (codes, scales)
 
         def shard_fn(params, pool_k, pool_v, tokens, pos, seeds, tbl):
+            params = self._deq(params)   # int8 decode weights dequant here
             cos_t, sin_t = self._tables()
             pool_k, pool_v, logits = _paged_decode_one(
                 model, params, pool_k, pool_v, tokens, pos, tbl, ps,
@@ -597,17 +634,19 @@ class PagedEngine:
 
         fn = jax.shard_map(
             shard_fn, mesh=self.mesh,
-            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None), P(None),
+            in_specs=(self._pspec, pspec, pspec, P(None), P(None),
                       P(None), P(None, None)),
-            out_specs=(POOL_SPEC, POOL_SPEC,
+            out_specs=(pspec, pspec,
                        P(None, "tp") if debug else P(None)))
         return jax.jit(fn, donate_argnums=(1, 2))
 
     def _build_chunk(self, cw: int):
         model, ps, dtype = self.model, self.page_size, self._dtype
+        pspec = self.pool.pspec
 
         def shard_fn(params, pool_k, pool_v, chunk, start, qlen, tbl,
                      dstp, dsto, seeds):
+            params = self._deq(params)
             cos_t, sin_t = self._tables()
             pool_k, pool_v, logits = _paged_prefill_chunk(
                 model, params, pool_k, pool_v, chunk, start, qlen, tbl,
@@ -617,10 +656,10 @@ class PagedEngine:
 
         fn = jax.shard_map(
             shard_fn, mesh=self.mesh,
-            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None, None),
+            in_specs=(self._pspec, pspec, pspec, P(None, None),
                       P(None), P(None), P(None, None), P(None, None),
                       P(None, None), P(None)),
-            out_specs=(POOL_SPEC, POOL_SPEC, P(None)))
+            out_specs=(pspec, pspec, P(None)))
         return jax.jit(fn, donate_argnums=(1, 2))
 
     # -- request intake ---------------------------------------------------
@@ -877,7 +916,7 @@ class PagedEngine:
             self._chunk_fns[cw] = self._build_chunk(cw)
         with self._span("prefill_chunk", slot=slot, pos0=s, n=n, cw=cw):
             ks, vs, tok = self._chunk_fns[cw](
-                self.params, self.pool.ks, self.pool.vs, jnp.asarray(buf),
+                self._params_in, self.pool.ks, self.pool.vs, jnp.asarray(buf),
                 jnp.asarray([s], np.int32), jnp.asarray([n], np.int32),
                 jnp.asarray(self._tbl[slot:slot + 1]), jnp.asarray(dstp),
                 jnp.asarray(dsto),
@@ -927,11 +966,23 @@ class PagedEngine:
             self._ensure_writable(slot, pos, pos + 1)
         if not self._slot_req:
             return
+        # the dispatch is dense over ALL slot rows, and a non-live row
+        # (a slot mid-prefill, or freed this step) still flows through it
+        # with cursor 0 and a stale pending token — so its spurious
+        # position-0 K/V write must land on the scratch page, NOT the real
+        # (possibly shared) page its table maps. Freed slots' tables are
+        # already all-scratch; mid-prefill slots' are not, so mask them
+        # here rather than hand the program a live page to scribble on.
+        tbl = self._tbl
+        if self._prefilling:
+            tbl = self._tbl.copy()
+            for slot in self._prefilling:
+                tbl[slot, :] = self.pool.scratch_page
         with self._span("decode_step", live=len(self._slot_req)):
             ks, vs, tok = self._step_fn(
-                self.params, self.pool.ks, self.pool.vs,
+                self._params_in, self.pool.ks, self.pool.vs,
                 jnp.asarray(self._tokens), jnp.asarray(self._pos),
-                jnp.asarray(self._seeds), jnp.asarray(self._tbl))
+                jnp.asarray(self._seeds), jnp.asarray(tbl))
             self.pool.adopt(ks, vs)
             if self._debug_host_sampler:
                 tok = host_sample_tokens(
@@ -991,6 +1042,7 @@ class PagedEngine:
             "prefill_positions": self.prefill_positions,
             # -- token-granular occupancy (the paged win, measured) ------
             "page_size": self.page_size,
+            "kv_dtype": self.kv_dtype or "native",
             "num_pages": self.pool.num_pages,
             "pages_in_use": self.pool.pages_in_use,
             "pages_in_use_mean": round(self._pages_used_sum / steps

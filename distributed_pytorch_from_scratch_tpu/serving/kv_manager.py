@@ -42,22 +42,34 @@ from ..config import resolve_dtype
 # pool layout: (layers, slots, kv_heads, buf, head_dim); 'tp' shards the
 # heads dim, everything else replicated — matches models/decode.py caches
 POOL_SPEC = P(None, None, "tp", None, None)
+# int8 pools carry a parallel scale array (layers, pages, kv_heads, page)
+# — one f32 per stored head-vector; same 'tp'-on-heads partitioning
+KV_SCALE_SPEC = P(None, None, "tp", None)
 
 
-def kv_token_bytes(cfg) -> int:
+def kv_token_bytes(cfg, kv_dtype=None) -> int:
     """K+V cache bytes per TOKEN POSITION at a model shape (all layers,
     all kv heads, both K and V, global across tp). The equal-HBM accounting
     unit: bench.py's serving A/B spends `slots x buf_len` of these on the
     slot engine and must hand the paged/speculative arms the same number —
     including the speculative drafter's pages, which buy acceptance, not
-    capacity, and therefore count against the budget."""
-    itemsize = jnp.dtype(resolve_dtype(cfg.compute_dtype)).itemsize
-    return 2 * cfg.num_layers * cfg.kv_heads * cfg.head_dim * itemsize
+    capacity, and therefore count against the budget.
+
+    `kv_dtype='int8'` prices the quantized pool HONESTLY: one int8 code
+    per element PLUS the f32 scale per stored head-vector — so the int8
+    capacity win the budget math grants is (itemsize x hd) / (hd + 4),
+    ~2x under bf16 at hd 64, never the naive 2x that ignores scales."""
+    if kv_dtype in ("int8", jnp.int8):
+        per_head = cfg.head_dim + 4            # int8 codes + f32 scale
+    else:
+        itemsize = jnp.dtype(resolve_dtype(cfg.compute_dtype)).itemsize
+        per_head = cfg.head_dim * itemsize
+    return 2 * cfg.num_layers * cfg.kv_heads * per_head
 
 
-def page_bytes(cfg, page_size: int) -> int:
+def page_bytes(cfg, page_size: int, kv_dtype=None) -> int:
     """K+V bytes of ONE page at a model shape (scratch page excluded)."""
-    return kv_token_bytes(cfg) * page_size
+    return kv_token_bytes(cfg, kv_dtype) * page_size
 
 
 class KVCachePool:
@@ -73,6 +85,7 @@ class KVCachePool:
         self.dtype = resolve_dtype(cfg.compute_dtype)
         shape = (cfg.num_layers, num_slots + 1, cfg.kv_heads, buf_len,
                  cfg.head_dim)
+        self.pspec = POOL_SPEC    # uniform engine-facing spec handle
         sharding = NamedSharding(mesh, POOL_SPEC)
         alloc = jax.jit(lambda: jnp.zeros(shape, self.dtype),
                         out_shardings=sharding)
@@ -163,23 +176,50 @@ class PagedKVPool:
     The LAST page (index num_pages) is scratch: free slots' page tables
     and chunk-pad columns aim their writes at it, and nothing ever
     attends to it (the same quarantine trick as the slot pool's scratch
-    row)."""
+    row).
 
-    def __init__(self, model, mesh: Mesh, num_pages: int, page_size: int):
+    `kv_dtype='int8'` (ISSUE 8) stores pages as int8 CODES with a parallel
+    f32 scale array — one scale per (layer, page, head, position), i.e.
+    per stored head-vector, so decode's append-only writes never have to
+    requantize a page's earlier positions. `ks`/`vs` then become
+    (codes, scales) TUPLES that flow through the same lease/COW/free
+    refcount accounting (copy_pages copies both members); the decode
+    programs quantize on write and dequantize the gathered page view
+    (models/decode.py), so the attend math is unchanged. At the same HBM
+    budget an int8 pool holds ~(itemsize x hd)/(hd + 4) x the tokens —
+    the capacity win `bench.py --serving --kv_dtype int8` measures."""
+
+    def __init__(self, model, mesh: Mesh, num_pages: int, page_size: int,
+                 kv_dtype=None):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if kv_dtype not in (None, "native", "int8", jnp.int8):
+            raise ValueError(f"kv_dtype must be None/'native'/'int8', got "
+                             f"{kv_dtype!r}")
         cfg = model.cfg
         self.num_pages = num_pages
         self.page_size = page_size
         self.scratch_page = num_pages          # never leased; pad target
-        self.dtype = resolve_dtype(cfg.compute_dtype)
+        self.kv_dtype = "int8" if kv_dtype in ("int8", jnp.int8) else None
         shape = (cfg.num_layers, num_pages + 1, cfg.kv_heads, page_size,
                  cfg.head_dim)
-        self._sharding = NamedSharding(mesh, POOL_SPEC)
-        alloc = jax.jit(lambda: jnp.zeros(shape, self.dtype),
-                        out_shardings=self._sharding)
+        if self.kv_dtype:
+            self.dtype = jnp.int8
+            self.pspec = (POOL_SPEC, KV_SCALE_SPEC)
+            self._sharding = (NamedSharding(mesh, POOL_SPEC),
+                              NamedSharding(mesh, KV_SCALE_SPEC))
+            alloc = jax.jit(
+                lambda: (jnp.zeros(shape, jnp.int8),
+                         jnp.ones(shape[:-1], jnp.float32)),
+                out_shardings=self._sharding)
+        else:
+            self.dtype = resolve_dtype(cfg.compute_dtype)
+            self.pspec = POOL_SPEC
+            self._sharding = NamedSharding(mesh, POOL_SPEC)
+            alloc = jax.jit(lambda: jnp.zeros(shape, self.dtype),
+                            out_shardings=self._sharding)
         self.ks = alloc()
         self.vs = alloc()
         self._free = deque(range(num_pages))
@@ -268,8 +308,10 @@ class PagedKVPool:
         sh = self._sharding
 
         def fn(pk, pv, src, dst):
-            return (pk.at[:, dst].set(pk[:, src]),
-                    pv.at[:, dst].set(pv[:, src]))
+            # dim 1 is the page dim for codes (5-D) and scales (4-D)
+            # alike, so one tree-mapped copy serves both pool layouts
+            cp = lambda a: a.at[:, dst].set(a[:, src])
+            return jax.tree.map(cp, pk), jax.tree.map(cp, pv)
 
         return jax.jit(fn, donate_argnums=(0, 1),
                        out_shardings=(sh, sh))

@@ -82,6 +82,13 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                    help="0 = greedy; > 0 samples with per-request seeds")
     g.add_argument("--decode_top_k", type=int, default=0)
     g.add_argument("--decode_top_p", type=float, default=0.0)
+    g.add_argument("--decode_weight_dtype", choices=["native", "int8"],
+                   default="native",
+                   help="'int8' serves weight-only-quantized decode "
+                        "weights (per-output-channel scales, dequant-on-"
+                        "use inside the compiled programs — cuts the "
+                        "weight-read HBM floor; ops/quant.py). Works for "
+                        "both engines")
 
     g = p.add_argument_group("paged engine (serving v2)")
     g.add_argument("--paged", action="store_true",
@@ -90,6 +97,13 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "the SLO-aware scheduler (docs/SERVING.md v2)")
     g.add_argument("--page_size", type=int, default=64,
                    help="--paged: tokens per KV page")
+    g.add_argument("--kv_dtype", choices=["native", "int8"],
+                   default="native",
+                   help="--paged: KV-page storage dtype. 'int8' stores "
+                        "block-scaled codes + per-head-vector scales "
+                        "(~2x the tokens per HBM byte at hd 64; greedy "
+                        "quality pinned in tests/test_quant.py); the "
+                        "speculative drafter pool inherits it")
     g.add_argument("--num_pages", type=int, default=0,
                    help="--paged: page-pool HBM budget in pages (0 = "
                         "slots x ceil(buf_len/page_size), i.e. no "
@@ -176,6 +190,9 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     if not args.paged:
         if args.num_pages:
             p.error("--num_pages is a --paged knob")
+        if args.kv_dtype != "native":
+            p.error("--kv_dtype is a --paged knob (the slot pool stores "
+                    "the compute dtype; only PagedKVPool quantizes)")
         if args.class_mix:
             p.error("--class_mix needs --paged (the FIFO engine has no "
                     "SLO classes)")
@@ -331,6 +348,9 @@ def serve(args: argparse.Namespace) -> dict:
     tracer = SpanTracer(args.log_dir, process_name="serve")
     writer = MetricsWriter(args.log_dir, process_index=0)
     try:
+        kv_dtype = None if args.kv_dtype == "native" else args.kv_dtype
+        wdtype = (None if args.decode_weight_dtype == "native"
+                  else args.decode_weight_dtype)
         if args.paged:
             from .scheduler import parse_slo_classes
             paged_kw = dict(
@@ -338,7 +358,8 @@ def serve(args: argparse.Namespace) -> dict:
                 page_size=args.page_size, num_pages=args.num_pages,
                 prefill_chunk=args.prefill_chunk,
                 temperature=args.temperature, top_k=args.decode_top_k,
-                top_p=args.decode_top_p,
+                top_p=args.decode_top_p, kv_dtype=kv_dtype,
+                decode_weight_dtype=wdtype,
                 slo_classes=parse_slo_classes(args.slo_classes),
                 default_class=args.default_class,
                 max_queue=args.queue_limit, tracer=tracer, writer=writer)
@@ -364,6 +385,7 @@ def serve(args: argparse.Namespace) -> dict:
                 max_prefill_batch=args.max_prefill_batch,
                 max_queue=args.queue_limit,
                 debug_host_sampler=args.debug_host_sampler,
+                decode_weight_dtype=wdtype,
                 tracer=tracer, writer=writer)
         summary = run_loadgen(engine, requests)
     finally:
@@ -411,7 +433,8 @@ def serve(args: argparse.Namespace) -> dict:
             "tpot_ms_p50", "tpot_ms_p95", "queue_wait_ms_p50",
             "queue_wait_ms_p95", "prefill_pad_waste_eliminated")},
     }
-    for k in ("kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
+    for k in ("kv_dtype",
+              "kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
               "cow_copies", "preemptions", "max_live",
               "max_interleaved_prefill_positions", "slo_attainment",
               "speculate_k", "spec_rounds", "accepted_tokens_per_dispatch",
@@ -421,6 +444,8 @@ def serve(args: argparse.Namespace) -> dict:
             rec[k] = summary[k]
     if args.debug_host_sampler:
         rec["debug_host_sampler"] = True
+    if args.decode_weight_dtype != "native":
+        rec["decode_weight_dtype"] = args.decode_weight_dtype
     print(json.dumps(rec))
     return summary
 

@@ -146,7 +146,10 @@ class SpeculativeEngine(PagedEngine):
             # drafter pool is never the binding resource unless the caller
             # squeezes it (bench.py's equal-HBM arm does, via the budget)
             drafter_pages = num_slots * self._d_max_pages
-        self.dpool = PagedKVPool(drafter_model, mesh, drafter_pages, ps)
+        # the drafter pool inherits kv_dtype: int8 pages halve ITS budget
+        # share too, so the equal-HBM split stays one knob
+        self.dpool = PagedKVPool(drafter_model, mesh, drafter_pages, ps,
+                                 kv_dtype=self.kv_dtype)
         self._dtbl = np.full((num_slots, self._d_max_pages),
                              self.dpool.scratch_page, np.int32)
         self._draft_fn = self._build_draft()
@@ -213,12 +216,13 @@ class SpeculativeEngine(PagedEngine):
             q = lax.pmax(qs[:k].transpose(1, 0, 2), "tp")    # (b, k, V)
             return pool_k, pool_v, draft, q
 
-        out = (POOL_SPEC, POOL_SPEC, P(None, None))
+        dspec = self.dpool.pspec
+        out = (dspec, dspec, P(None, None))
         if temperature != 0.0:
             out = out + (P(None, None, None),)
         fn = jax.shard_map(
             shard_fn, mesh=self.mesh,
-            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None),
+            in_specs=(model.specs(), dspec, dspec, P(None),
                       P(None), P(None), P(None, None)),
             out_specs=out)
         return jax.jit(fn, donate_argnums=(1, 2))
@@ -240,6 +244,7 @@ class SpeculativeEngine(PagedEngine):
 
         def shard_fn(params, pool_k, pool_v, tokens, draft, pos, qlen, tbl,
                      dstp, dsto, seeds, *maybe_q):
+            params = self._deq(params)   # int8 decode weights (target)
             cos_t, sin_t = self._tables()
             pos = jnp.asarray(pos, jnp.int32)
             qlen = jnp.asarray(qlen, jnp.int32)
@@ -317,14 +322,15 @@ class SpeculativeEngine(PagedEngine):
             return (pool_k, pool_v, lax.pmax(n_acc, "tp"),
                     lax.pmax(out, "tp"))
 
-        in_specs = [model.specs(), POOL_SPEC, POOL_SPEC, P(None),
+        tspec = self.pool.pspec
+        in_specs = [self._pspec, tspec, tspec, P(None),
                     P(None, None), P(None), P(None), P(None, None),
                     P(None, None), P(None, None), P(None)]
         if temperature != 0.0:
             in_specs.append(P(None, None, None))
         fn = jax.shard_map(
             shard_fn, mesh=self.mesh, in_specs=tuple(in_specs),
-            out_specs=(POOL_SPEC, POOL_SPEC, P(None), P(None, None)))
+            out_specs=(tspec, tspec, P(None), P(None, None)))
         return jax.jit(fn, donate_argnums=(1, 2))
 
     def _build_drafter_chunk(self, cw: int):
@@ -340,12 +346,13 @@ class SpeculativeEngine(PagedEngine):
             # next round (the dead logits head DCEs out of the program)
             return pool_k, pool_v
 
+        dspec = self.dpool.pspec
         fn = jax.shard_map(
             shard_fn, mesh=self.mesh,
-            in_specs=(model.specs(), POOL_SPEC, POOL_SPEC, P(None, None),
+            in_specs=(model.specs(), dspec, dspec, P(None, None),
                       P(None), P(None), P(None, None), P(None, None),
                       P(None, None)),
-            out_specs=(POOL_SPEC, POOL_SPEC))
+            out_specs=(dspec, dspec))
         return jax.jit(fn, donate_argnums=(1, 2))
 
     # -- request intake ---------------------------------------------------
@@ -485,7 +492,7 @@ class SpeculativeEngine(PagedEngine):
         self.drafter_s += time.monotonic() - t0
         t0 = time.monotonic()
         with self._span("verify", live=len(self._slot_req), k=k):
-            vargs = [self.params, self.pool.ks, self.pool.vs,
+            vargs = [self._params_in, self.pool.ks, self.pool.vs,
                      jnp.asarray(self._tokens), draft,
                      jnp.asarray(self._pos), jnp.asarray(qlen),
                      jnp.asarray(self._tbl), jnp.asarray(dstp),
@@ -572,8 +579,8 @@ class SpeculativeEngine(PagedEngine):
             "drafter_num_pages": self.dpool.num_pages,
             "drafter_pages_in_use": self.dpool.pages_in_use,
             "drafter_page_bytes": page_bytes(self.drafter_model.cfg,
-                                             self.page_size),
+                                             self.page_size, self.kv_dtype),
             "target_page_bytes": page_bytes(self.model.cfg,
-                                            self.page_size),
+                                            self.page_size, self.kv_dtype),
         })
         return st

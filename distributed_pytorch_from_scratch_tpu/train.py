@@ -76,12 +76,16 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="Megatron-style SP: shard inter-block activations "
                         "over the tp axis (reduce-scatter/all-gather instead "
                         "of all-reduce)")
-    g.add_argument("--tp_overlap", choices=["off", "ring"], default="off",
+    g.add_argument("--tp_overlap", choices=["off", "ring", "ring_q"],
+                   default="off",
                    help="'ring' decomposes the SP tp collectives into ring "
                         "collective matmuls (ops/overlap.py): each ppermute "
                         "hop hides under the partial dot of the chunk in "
-                        "hand, fwd and bwd; requires --sequence_parallel. "
-                        "'off' stays bit-identical to the monolithic path")
+                        "hand, fwd and bwd; 'ring_q' puts int8 codes + "
+                        "per-row scales on every hop (half the bf16 chunk "
+                        "bytes; pinned bounds in tests/test_quant.py); "
+                        "requires --sequence_parallel. 'off' stays "
+                        "bit-identical to the monolithic path")
     g.add_argument("--zero1", action="store_true",
                    help="ZeRO-1: shard Adam moments over the dp axis "
                         "(2/dp optimizer memory per device)")
@@ -91,11 +95,13 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "remaining backward) instead of the end-of-step "
                         "whole-tree blob; 0 = off (the default transpose-"
                         "derived reducer). Dense models, --pp_size 1")
-    g.add_argument("--dp_reduce_dtype", choices=["f32", "bf16"],
+    g.add_argument("--dp_reduce_dtype", choices=["f32", "bf16", "int8"],
                    default="f32",
                    help="wire dtype for the bucketed DP grad reduce: 'bf16' "
-                        "halves the reduction bytes (EQuARX-style; the "
-                        "optimizer still accumulates f32 masters). Needs "
+                        "halves the reduction bytes, 'int8' quarters them "
+                        "via the EQuARX-style block-scaled quantized ring "
+                        "(ops/overlap.quantized_allreduce; f32 master "
+                        "accumulate either way). Needs "
                         "--dp_reduce_bucket_mb > 0")
     g.add_argument("--ep_size", type=int, default=1,
                    help="expert-parallel axis size (MoE: experts shard over "
@@ -419,10 +425,11 @@ def train(args: argparse.Namespace) -> dict:
                       f"tiles; CE masks the pad targets; tok/s and MFU "
                       f"count real tokens)")
         attn_t_real = maxlen if t_bucket else None
-        if args.dp_reduce_dtype == "bf16" and not args.dp_reduce_bucket_mb:
-            raise SystemExit("--dp_reduce_dtype bf16 needs "
-                             "--dp_reduce_bucket_mb > 0 (the compressed "
-                             "wire is a property of the bucketed reducer)")
+        if args.dp_reduce_dtype != "f32" and not args.dp_reduce_bucket_mb:
+            raise SystemExit(f"--dp_reduce_dtype {args.dp_reduce_dtype} "
+                             f"needs --dp_reduce_bucket_mb > 0 (the "
+                             f"compressed wire is a property of the "
+                             f"bucketed reducer)")
         if args.dp_reduce_bucket_mb and args.pp_size > 1:
             raise SystemExit("--dp_reduce_bucket_mb needs --pp_size 1 "
                              "(pp-replicated leaves' reduction axes depend "
@@ -543,9 +550,9 @@ def train(args: argparse.Namespace) -> dict:
                               moment_shardings=moment_sh if args.zero1 else None,
                               with_grad_norm=True,
                               dp_reduce_bucket_mb=args.dp_reduce_bucket_mb,
-                              dp_reduce_dtype=(jnp.bfloat16
-                                               if args.dp_reduce_dtype == "bf16"
-                                               else None))
+                              dp_reduce_dtype={"bf16": jnp.bfloat16,
+                                               "int8": jnp.int8}.get(
+                                                   args.dp_reduce_dtype))
         if accum > 1:
             step_fn = build_grad_accum_step(model, mesh, ocfg, args.loss_mode,
                                             **builder_kwargs)

@@ -85,10 +85,13 @@ def build_bucketed_grad_fn(model, mesh: Mesh, loss_mode: str = "vocab_parallel",
     issued explicitly by `ops.overlap.bucketed_psum` — one flattened psum
     per <= bucket_mb bucket, each depending only on its own cotangents, so
     XLA can launch it as soon as the backward produces them and hide the
-    wire under the remaining backward compute. `reduce_dtype`
-    (jnp.bfloat16) compresses the wire only; grads return to f32 before
-    the optimizer's master accumulate (EQuARX-style, no stochastic
-    rounding — tolerance bounds pinned in tests/test_overlap.py).
+    wire under the remaining backward compute. `reduce_dtype` compresses
+    the wire only; grads return to f32 before the optimizer's master
+    accumulate (EQuARX-style, no stochastic rounding): jnp.bfloat16
+    casts around the psum (bound pinned in tests/test_overlap.py),
+    jnp.int8 routes each bucket through the block-scaled quantized ring
+    (`ops/overlap.quantized_allreduce`; bound pinned in
+    tests/test_quant.py).
 
     Which axes each leaf reduces over: the batch axes (dp/ep/cp — params
     are replicated over them, data varies), plus 'tp' for tp-REPLICATED

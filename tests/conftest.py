@@ -84,6 +84,7 @@ CORE_LANE = {
         "test_cow_shared_prefix_identity_and_drain",
         "test_chunked_vs_whole_prefill_identity_and_stall_bound",
         "test_capacity_win_at_equal_hbm",
+        "test_interleaved_prefill_no_stale_row_scribble",
         "test_slo_scheduler_class_ordering_and_fairness",
         "test_paged_serve_dry_run_smoke",
     ],
@@ -98,6 +99,20 @@ CORE_LANE = {
         "test_host_sampler_matches_fused[paged]",
         "test_spec_refuses_invalid_configs",
         "test_spec_serve_dry_run_smoke",
+    ],
+    # quantized wires + caches (ISSUE 8): the shared-rule round-trip
+    # oracles, the int8 DP-wire error pin (the bf16 canary's sibling),
+    # one ring_q kernel bound, the int8-KV greedy-quality pin + the
+    # equal-HBM capacity criterion, the CLI scope refusals, and the
+    # int8 serve dry-run rot guard
+    "test_quant.py": [
+        "test_quantize_roundtrip_oracles",
+        "test_bucketed_reduce_int8_wire_tolerance",
+        "test_ring_q_kernels_match_oracles_within_bound[2]",
+        "test_int8_kv_greedy_pin[1]",
+        "test_int8_kv_capacity_win_at_equal_hbm",
+        "test_ring_q_refusals",
+        "test_quant_serve_dry_run_smoke",
     ],
     "test_sequence_parallel.py": ["test_model_sp_matches_vanilla[1-1-4]"],
     "test_overlap.py": ["test_ag_matmul_matches_gather_dot_oracle[1-2]",

@@ -89,8 +89,9 @@ def test_breakdown_bench_emits_one_json_line():
     assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
     rec = json.loads(lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "components", "attribution"}
+                        "components", "wire_dtype", "attribution"}
     assert rec["unit"] == "ms/step"
+    assert rec["wire_dtype"] == "f32"   # default: uncompressed DP wire
     comp = rec["components"]
     for key in ("h2d_ms", "fwd_ms", "fwdbwd_ms", "step_ms", "step_ms_spd4",
                 "derived_bwd_ms", "derived_adam_ms", "derived_dispatch_ms"):
@@ -131,8 +132,8 @@ def test_breakdown_analytic_emits_one_json_line():
     lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
     rec = json.loads(lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline", "comm",
-                        "suspects"}
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "wire_dtype", "tp_overlap", "comm", "suspects"}
     assert rec["unit"] == "ms/step (analytic)"
     assert rec["value"] > 0
     names = [s["name"] for s in rec["suspects"]]

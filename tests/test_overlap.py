@@ -215,7 +215,7 @@ def test_model_ring_overlap_matches_inside_ring_cp_pipeline():
 def test_tp_overlap_validation():
     with pytest.raises(ValueError, match="requires sequence_parallel"):
         Transformer(CFG, tp_size=2, tp_overlap="ring")
-    with pytest.raises(ValueError, match="'off' or 'ring'"):
+    with pytest.raises(ValueError, match="'off', 'ring' or 'ring_q'"):
         Transformer(CFG, tp_size=2, sequence_parallel=True,
                     tp_overlap="mesh")
     moe_cfg = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,

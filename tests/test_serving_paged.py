@@ -222,6 +222,35 @@ def test_preempt_resume_token_identity():
     _assert_drained(eng)
 
 
+def test_interleaved_prefill_no_stale_row_scribble():
+    """Regression (found by ISSUE 8's capacity test): the decode dispatch
+    is dense over ALL slot rows, so a slot MID-PREFILL flows through it
+    with cursor 0 and whatever pending token its previous occupant left —
+    and before the fix, that spurious write landed at position 0 of the
+    prefilling slot's REAL page through its page table, corrupting the
+    resumed/late request's prompt KV. The trigger needs (a) slot reuse (a
+    fresh slot's stale token is 0, which happens to be every prompt's
+    first id here, masking the bug) and (b) decode steps interleaved with
+    a chunked prefill. Six requests churning through a pool sized for
+    heavy preemption hit both deterministically; every output must still
+    match its solo decode."""
+    mesh, model, params = _setup(1, seed=3)
+    dec = GreedyDecoder(model, mesh, BUF)
+    prompts = [[0, i + 2, i + 3, i + 5, i + 7, 11, 13, 2] for i in range(6)]
+    refs = [dec.decode(params, p, EOS, max_total_len=len(p) + 8)
+            for p in prompts]
+    eng = PagedEngine(model, mesh, params, num_slots=6, buf_len=BUF,
+                      eos_id=EOS, page_size=8, num_pages=8, prefill_chunk=8)
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=i, prompt=list(p), max_new=8))
+    eng.run_to_completion()
+    got = {r.rid: r.tokens for r in eng.completed}
+    for i, ref in enumerate(refs):
+        assert got[i] == ref, (i, got[i], ref)
+    assert eng.preemptions >= 1          # the churn actually happened
+    _assert_drained(eng)
+
+
 def test_paged_sampling_reproducible_per_request_seed():
     """Sampled decoding through the paged path: a request's tokens are a
     pure function of ITS seed (fold_in(seed, position) draws), regardless

@@ -25,12 +25,13 @@ R5 = os.path.join(REPO, "runs", "r5")
 # every staged session dir gets preflighted (r6 stages the fast-45m pass,
 # r7 the comm-overlap A/B, r8 the serving loadgen sweep, r9 the paged
 # serving-v2 sweep + slot-vs-paged A/B, r10 the speculative k-sweep +
-# fused-sampler ablation)
+# fused-sampler ablation, r11 the int8 wire sweep + int8-KV serving arms)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
                             os.path.join(REPO, "runs", "r9"),
-                            os.path.join(REPO, "runs", "r10"))
+                            os.path.join(REPO, "runs", "r10"),
+                            os.path.join(REPO, "runs", "r11"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
