@@ -92,6 +92,17 @@ def parse_args(argv=None):
                         "tests/test_quant.py); the breakdown/attribution "
                         "then reports the comm the ring hides. Requires "
                         "--sequence_parallel")
+    p.add_argument("--zero", type=int, choices=[0, 1, 2, 3], default=0,
+                   help="ZeRO stage over dp (training/zero.py): 1 shards "
+                        "the Adam moments, 2 also reduce-scatters the "
+                        "grads (half the DP wire bytes; implies the "
+                        "bucketed reducer) with one param all-gather per "
+                        "step, 3 also shards the params with per-layer "
+                        "gather-on-demand (peak param HBM full/dp + one "
+                        "layer). The record carries zero_stage + the "
+                        "measured param_bytes_per_device. Stages 2/3: "
+                        "dense presets, SP whenever tp > 1; stage 3 needs "
+                        "remat (defaults to dots) and an f32 wire")
     p.add_argument("--dp_reduce_bucket_mb", type=float, default=0.0,
                    help="bucketed DP grad reduction: one psum per <= N-MiB "
                         "bucket (overlappable with the backward) instead "
@@ -203,7 +214,26 @@ def parse_args(argv=None):
     if args.decode_weight_dtype != "native" and not args.serving:
         p.error("--decode_weight_dtype is a --serving knob")
     if args.remat is None:
-        args.remat = "dots" if args.model == "gpt2-355m" else "false"
+        # zero 3 pairs with remat: without it the gathered layer weights
+        # would be saved as backward residuals (full replica again)
+        args.remat = ("dots" if args.model == "gpt2-355m" or args.zero == 3
+                      else "false")
+    if args.zero == 3 and args.remat == "false":
+        p.error("--zero 3 needs remat (dots/true/auto): without remat, "
+                "autodiff saves every layer's gathered weights as "
+                "backward residuals, recreating the full param replica")
+    if args.zero == 3 and args.dp_reduce_dtype != "f32":
+        p.error(f"--dp_reduce_dtype {args.dp_reduce_dtype} with --zero 3: "
+                f"the ZeRO-3 grad reduce-scatter rides the parameter "
+                f"all-gather's transpose (f32 ppermute ring) — the "
+                f"compressed wire applies to --zero 2")
+    if args.zero >= 2 and args.model.endswith("-moe8"):
+        p.error(f"--zero {args.zero} does not compose with MoE presets "
+                f"(expert grads are ep-sharded, not batch-replicated); "
+                f"--zero 1 shards MoE moments fine")
+    if args.zero and (args.serving or args.decode):
+        p.error("--zero is a training knob; it does not apply to "
+                "--serving/--decode (any stage would be silently ignored)")
     if args.analytic and not args.breakdown:
         p.error("--analytic is a --breakdown mode")
     if args.analytic and args.remat == "auto":
@@ -214,10 +244,11 @@ def parse_args(argv=None):
         p.error(f"--tp_overlap {args.tp_overlap} requires "
                 f"--sequence_parallel (the ring decomposes the SP "
                 f"all-gather/reduce-scatter pair)")
-    if args.dp_reduce_dtype != "f32" and not args.dp_reduce_bucket_mb:
+    if (args.dp_reduce_dtype != "f32" and not args.dp_reduce_bucket_mb
+            and args.zero != 2):
         p.error(f"--dp_reduce_dtype {args.dp_reduce_dtype} needs "
                 f"--dp_reduce_bucket_mb > 0 (the compressed wire rides "
-                f"the bucketed reducer)")
+                f"the bucketed reducer; --zero 2 implies it)")
     if args.dp_reduce_bucket_mb and args.model.endswith("-moe8"):
         p.error("--dp_reduce_bucket_mb does not compose with MoE presets "
                 "(expert grads are ep-sharded, not batch-replicated)")
@@ -244,11 +275,53 @@ def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto",
 
 
 def dp_reduce_kwargs(args):
-    """Step-builder kwargs for the bucketed DP grad reduce flags."""
+    """Step-builder kwargs for the bucketed DP grad reduce + ZeRO flags."""
     wire = {"bf16": jnp.bfloat16, "int8": jnp.int8}.get(
         args.dp_reduce_dtype)
     return dict(dp_reduce_bucket_mb=args.dp_reduce_bucket_mb,
-                dp_reduce_dtype=wire)
+                dp_reduce_dtype=wire, zero=args.zero)
+
+
+def zero_state_put(args, model, mesh, params):
+    """(params_on_device, moment_shardings | None) at the --zero stage's
+    RESTING layouts (training/zero.py): stage 3 puts params dp-sharded
+    (the forward gathers per layer), stages 1/2 dp-shard the moments."""
+    if args.zero >= 3:
+        from distributed_pytorch_from_scratch_tpu.training.zero import (
+            zero3_shardings)
+        sh = zero3_shardings(model, mesh)
+        return jax.device_put(params, sh), sh
+    params = jax.device_put(params, model.shardings(mesh))
+    if args.zero >= 1:
+        from distributed_pytorch_from_scratch_tpu.training.zero import (
+            zero1_moment_shardings)
+        return params, zero1_moment_shardings(model, mesh)
+    return params, None
+
+
+def put_opt_state(opt_state, mesh, moment_sh):
+    """device_put the Adam state at the ZeRO moment layout (no-op when the
+    stage keeps moments on the param shardings)."""
+    if moment_sh is None:
+        return opt_state
+    from jax.sharding import NamedSharding, PartitionSpec
+    return jax.device_put(opt_state, opt_state.__class__(
+        step=NamedSharding(mesh, PartitionSpec()),
+        mu=moment_sh, nu=moment_sh))
+
+
+def param_bytes_per_device(params) -> int:
+    """MEASURED resident param bytes per mesh device (sums every leaf's
+    addressable shards — a replicated leaf counts once per device, a
+    dp-sharded one 1/dp as much — divided by the devices actually holding
+    shards, NOT jax.local_device_count(): a dp2 mesh on an 8-device host
+    must not report 1/8th). The record field the ZeRO-3 memory claim is
+    pinned on rather than asserted."""
+    leaves = jax.tree.leaves(params)
+    total = sum(sum(s.data.nbytes for s in leaf.addressable_shards)
+                for leaf in leaves)
+    devices = {s.device for leaf in leaves for s in leaf.addressable_shards}
+    return int(total // max(len(devices), 1))
 
 
 def bucket_shape(args, cfg):
@@ -652,6 +725,12 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
     T, T_pad = bucket_shape(args, cfg)
     world = args.dp * tp
 
+    # zero 2's grad wire IS the bucketed reduce-scatter: price it at the
+    # default bucket when the flag was left 0 (matching the step builder)
+    dp_bucket_mb = args.dp_reduce_bucket_mb
+    if args.zero == 2 and not dp_bucket_mb:
+        dp_bucket_mb = 25.0
+
     def emit(measured=None, comp=None, allreduce_us=None):
         report = attribution(
             cfg, B, T_pad, remat=args.remat, spd=spd,
@@ -659,9 +738,10 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
             measured=measured, chip=chip_key(), world=world,
             family=args.family, tp=tp, sp=args.sequence_parallel,
             tp_overlap=args.tp_overlap, dp=args.dp,
-            dp_bucket_mb=args.dp_reduce_bucket_mb,
+            dp_bucket_mb=dp_bucket_mb,
             dp_reduce_dtype=args.dp_reduce_dtype,
-            measured_allreduce_us=allreduce_us)
+            measured_allreduce_us=allreduce_us,
+            zero_stage=args.zero)
         print(format_attribution(report, measured), file=sys.stderr)
         return report
 
@@ -683,6 +763,8 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
             # r11 attribution: the wire dtypes the comm was PRICED at
             "wire_dtype": args.dp_reduce_dtype,
             "tp_overlap": args.tp_overlap,
+            # r12: the DP schedule the comm was priced at (AR vs RS+AG)
+            "zero_stage": args.zero,
             "comm": {
                 "total_ms": round(comm["comm_total_ms"], 3),
                 "hidden_ms": round(comm["comm_hidden_ms"], 3),
@@ -701,8 +783,9 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         cfg = dataclasses.replace(cfg, maxlen=T)
     model = build_model(args, cfg, tp, remat=args.remat,
                         attn_t_real=T if T_pad > T else None)
-    params = jax.device_put(model.init(jax.random.key(0)),
-                            model.shardings(mesh))
+    params, moment_sh = zero_state_put(args, model, mesh,
+                                       model.init(jax.random.key(0)))
+    pbpd = param_bytes_per_device(params)
     # ADVICE r5: the param-derived FLOPs count must happen BEFORE the
     # donating step programs consume the `params` buffers below — the
     # helper only reads `.size` metadata today, but a donated tree is one
@@ -774,8 +857,9 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
                              f"{str(e)[:200]}")
 
     # full step programs donate params/opt_state: thread them through
-    opt_state = init_adam_state(params)
-    step_fn = build_train_step(model, mesh, ocfg, **dp_reduce_kwargs(args))
+    opt_state = put_opt_state(init_adam_state(params), mesh, moment_sh)
+    step_fn = build_train_step(model, mesh, ocfg, moment_shardings=moment_sh,
+                               **dp_reduce_kwargs(args))
     state = [params, opt_state]
 
     def one_step():
@@ -787,11 +871,13 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
     ids_n, tgt_n, pos_n = (jnp.tile(x[None], (spd, 1, 1))
                            for x in (ids, tgt, pos))
     multi_fn = build_train_step_multi(model, mesh, ocfg,
+                                      moment_shardings=moment_sh,
                                       **dp_reduce_kwargs(args))
     # fresh state: the donated buffers above were consumed
-    params2 = jax.device_put(model.init(jax.random.key(0)),
-                             model.shardings(mesh))
-    state = [params2, init_adam_state(params2)]
+    params2, _ = zero_state_put(args, model, mesh,
+                                model.init(jax.random.key(0)))
+    state = [params2, put_opt_state(init_adam_state(params2), mesh,
+                                    moment_sh)]
 
     def multi_step():
         state[0], state[1], loss = multi_fn(state[0], state[1], ids_n,
@@ -837,6 +923,8 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         "vs_baseline": round(step_s / multi_s, 3),
         "components": comp,
         "wire_dtype": args.dp_reduce_dtype,
+        "zero_stage": args.zero,
+        "param_bytes_per_device": pbpd,
         "attribution": {
             "analytic_step_ms": round(report["analytic_step_ms"], 2),
             "chip": report["chip"],
@@ -917,6 +1005,11 @@ def main(argv=None):
                          "--sequence_parallel (the non-SP path all-reduces "
                          "inside every row-parallel layer; see "
                          "training/zero.build_bucketed_grad_fn)")
+    if args.zero >= 2 and tp > 1 and not args.sequence_parallel:
+        raise SystemExit(f"--zero {args.zero} with tp > 1 needs "
+                         f"--sequence_parallel (the stage-2/3 grad paths "
+                         f"ride the bucketed reducer's per-leaf cotangent "
+                         f"bookkeeping; see training/zero.py)")
     cfg = model_preset(args.model, compute_dtype="bfloat16")
     if args.seq_bucket and cfg.num_experts:
         raise SystemExit("--seq_bucket does not compose with MoE presets: "
@@ -933,7 +1026,8 @@ def main(argv=None):
             select_remat)
         args.remat = select_remat(cfg, default_batch(args),
                                   args.seqlen or cfg.maxlen,
-                                  tp=tp, world=args.dp * tp)
+                                  tp=tp, world=args.dp * tp,
+                                  zero_stage=args.zero, dp=args.dp)
     if args.decode or args.breakdown or args.serving:
         if args.introspect and (args.decode or args.serving):
             print("bench: --introspect does not apply to --decode/"
@@ -961,14 +1055,18 @@ def main(argv=None):
         # real stream (shapes are what matter), one H2D instead of N
         ids, tgt, pos = (jnp.tile(x[None], (spd, 1, 1)) for x in (ids, tgt, pos))
 
+    pbpd = [None]  # measured resident param bytes/device (ZeRO record)
+
     def build(remat, attn_impl):
         model = build_model(args, cfg, tp, remat=remat, attn_impl=attn_impl,
                             attn_t_real=T if T_pad > T else None)
-        params = jax.device_put(model.init(jax.random.key(0)),
-                                model.shardings(mesh))
-        opt_state = init_adam_state(params)
+        params, moment_sh = zero_state_put(args, model, mesh,
+                                           model.init(jax.random.key(0)))
+        pbpd[0] = param_bytes_per_device(params)
+        opt_state = put_opt_state(init_adam_state(params), mesh, moment_sh)
         builder = build_train_step_multi if spd > 1 else build_train_step
         return params, opt_state, builder(model, mesh, ocfg,
+                                          moment_shardings=moment_sh,
                                           **dp_reduce_kwargs(args))
 
     # Fallback ladder: the requested config first, then progressively safer
@@ -1073,6 +1171,8 @@ def main(argv=None):
     if args.dp_reduce_bucket_mb:
         overlap_note += (f", dp_reduce_bucket={args.dp_reduce_bucket_mb:g}MiB"
                          f" {args.dp_reduce_dtype}")
+    if args.zero:
+        overlap_note += f", zero={args.zero}"
     print(json.dumps({
         "metric": (f"tokens/sec/chip ({args.model} {args.family}, bf16, b{B}xt{T}, "
                    f"dp={args.dp}, tp={tp}, remat={remat_used}, "
@@ -1081,6 +1181,10 @@ def main(argv=None):
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(mfu / 0.30, 4),
+        # r12: which ZeRO stage trained and what it actually left resident
+        # per device — the memory claim is measured, not asserted
+        "zero_stage": args.zero,
+        "param_bytes_per_device": pbpd[0],
     }))
 
 

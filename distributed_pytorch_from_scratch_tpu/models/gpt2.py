@@ -100,6 +100,10 @@ class GPT2Transformer:
     # Transformer.attn_t_real (real token count inside a bucket-padded
     # batch; attention skips the pad tiles, CE masks the pad targets).
     attn_t_real: "int | None" = None
+    # ZeRO-3 per-layer param gather — same contract as
+    # Transformer.zero3_axis (set only by training/zero.build_zero3_grad_fn
+    # on its private model copy; every other path leaves it None).
+    zero3_axis: "str | None" = None
 
     def __post_init__(self):
         cfg, tp = self.cfg, self.tp_size
@@ -256,6 +260,12 @@ class GPT2Transformer:
         contract as `Transformer._layer_body` (the shared
         `_live_gated_ring` wraps the dense segments in lax.cond while the
         ring's ppermutes run unconditionally)."""
+        if self.zero3_axis:
+            # ZeRO-3 per-layer gather — same contract as
+            # Transformer._layer_body (inside remat; transpose
+            # reduce-scatters the weight grads to this rank's shard)
+            from ..training.zero import zero3_layer_gather
+            lp = zero3_layer_gather(self, lp, self.zero3_axis)
         m = self._mods
         h = self.cfg.head_dim
         # sequence parallelism: x is (b, t/tp, d) between sublayers; the

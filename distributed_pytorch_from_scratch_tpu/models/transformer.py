@@ -282,6 +282,14 @@ class Transformer:
     # the ring/ulysses paths shard the sequence and carry their own
     # position masking).
     attn_t_real: "int | None" = None
+    # ZeRO-3 (training/zero.py): when set to a mesh axis name (normally
+    # 'dp'), the layer body ring-all-gathers each layer's dp-sharded param
+    # leaves on entry — INSIDE the remat boundary, so the gathered weights
+    # are recomputed (never saved as backward residuals) and peak param
+    # HBM stays full/dp + one layer. Only `build_zero3_grad_fn` sets this
+    # (via dataclasses.replace on its private model copy); every other
+    # path keeps params at model.specs() layouts and must leave it None.
+    zero3_axis: "str | None" = None
 
     def __post_init__(self):
         cfg, tp = self.cfg, self.tp_size
@@ -506,6 +514,15 @@ class Transformer:
         deadlock otherwise) with the per-block MXU work gated inside the
         ring (ops/ring_attention.py). Bubble steps therefore cost only the
         ring's wire traffic, not layer FLOPs (VERDICT r3 #3)."""
+        if self.zero3_axis:
+            # ZeRO-3: this layer's dp-sharded leaves gather here, inside
+            # the remat boundary, so the gathered weights are transient in
+            # the forward and REPLAYED (not saved) for the backward; the
+            # gather's transpose reduce-scatters the weight grads back to
+            # this rank's shard. training/zero.py owns the layout rule.
+            from ..training.zero import zero3_layer_gather
+            layer_params = zero3_layer_gather(self, layer_params,
+                                              self.zero3_axis)
         m = self._mods
         h = self.cfg.head_dim
         # In sequence-parallel mode x is (b, t/tp, d) between sublayers; the

@@ -241,7 +241,8 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
                      chip: str = "v5e", family: str = "llama",
                      remat: str = "dots",
                      measured_allreduce_us: Optional[float] = None,
-                     phase_ms: Optional[Dict[str, float]] = None) -> Dict:
+                     phase_ms: Optional[Dict[str, float]] = None,
+                     zero_stage: int = 0) -> Dict:
     """Per-collective comm attribution with an overlap model: how many ms
     of ICI time the step spends, and how much of it HIDES under the matmul
     each collective is (or could be) fused with.
@@ -261,6 +262,16 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
       overhead, 4/WIRE_GROUP < 1%, is deliberately ignored) — a record
       that kept pricing the compute dtype would silently misreport the
       quantized wire as hidden/exposed ms it no longer spends.
+    * `zero_stage` reshapes the DP schedule (training/zero.py). <= 1: one
+      grad ALL-REDUCE, 2(dp-1)/dp x P x wire bytes. 2: a grad
+      REDUCE-SCATTER at HALF those bytes ((dp-1)/dp x P — each rank
+      receives only its shard) plus the end-of-step f32 param all-gather
+      XLA inserts for the replicated params. 3: no explicit grad
+      collective at all — per-layer param all-gathers (fwd, and again in
+      the remat'd backward) whose TRANSPOSE is the grad reduce-scatter,
+      all f32 ppermute rings hidden up to the adjacent compute. A record
+      that kept pricing the stage-1 all-reduce would assert the halved
+      wire instead of showing it.
 
     `phase_ms` (name -> analytic ms from `analytic_phases`) supplies the
     overlap budgets; computed here when omitted.
@@ -343,15 +354,51 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
         P_count = cfg.num_params()
         wire_itemsize = {"bf16": 2, "bfloat16": 2,
                          "int8": 1}.get(dp_reduce_dtype, 4)
-        nbytes = 2 * (dp - 1) / dp * P_count * wire_itemsize
+        shard_bytes = (dp - 1) / dp * P_count  # RS or AG wire, per element
         bucketed = dp_bucket_mb > 0
-        budget = phase_ms.get("backward", 0.0) if bucketed else 0.0
-        note = (f"bucketed ({dp_bucket_mb:g} MiB, {dp_reduce_dtype} wire): "
-                f"buckets overlap the remaining backward" if bucketed else
-                "end-of-step whole-tree blob: fully exposed "
-                "(--dp_reduce_bucket_mb to overlap)")
-        add("DP grad reduce", "all-reduce", 1, nbytes, 2 * (dp - 1),
-            budget, note)
+        bwd_budget = phase_ms.get("backward", 0.0)
+        if zero_stage >= 3:
+            # ZeRO-3: params gather per layer inside the scan (fwd, and
+            # again in the remat'd backward replay); the gathers'
+            # transposes ARE the grad reduce-scatter. All three rings are
+            # f32 (params/cotangents), per-layer, overlappable.
+            fwd_budget = sum(phase_ms.get(n, 0.0)
+                             for n in ("qkv_proj", "wo_proj", "ffn"))
+            add("ZeRO-3 param all-gather (fwd)", "all-gather", 1,
+                shard_bytes * 4, dp - 1, fwd_budget,
+                "per-layer ring inside the scan: hops hide under the "
+                "layer's matmuls")
+            add("ZeRO-3 param all-gather (bwd remat)", "all-gather", 1,
+                shard_bytes * 4, dp - 1, bwd_budget,
+                "the remat replay re-gathers each layer during the "
+                "backward")
+            add("ZeRO-3 grad reduce-scatter (bwd)", "reduce-scatter", 1,
+                shard_bytes * 4, dp - 1, bwd_budget,
+                "the gather's transpose: each rank receives only its "
+                "dp-summed shard (f32 wire)")
+        elif zero_stage == 2:
+            note = (f"bucketed ({dp_bucket_mb:g} MiB, {dp_reduce_dtype} "
+                    f"wire): half the all-reduce bytes — each rank "
+                    f"receives only its 1/dp grad shard"
+                    if bucketed else
+                    f"{dp_reduce_dtype} wire; half the all-reduce bytes")
+            add("DP grad reduce-scatter", "reduce-scatter", 1,
+                shard_bytes * wire_itemsize, dp - 1,
+                bwd_budget if bucketed else 0.0, note)
+            add("ZeRO-2 param all-gather", "all-gather", 1,
+                shard_bytes * 4, dp - 1, 0.0,
+                "end-of-step gather of the freshly updated params (f32); "
+                "--zero 3 gathers per-layer under compute instead")
+        else:
+            nbytes = 2 * shard_bytes * wire_itemsize
+            budget = bwd_budget if bucketed else 0.0
+            note = (f"bucketed ({dp_bucket_mb:g} MiB, {dp_reduce_dtype} "
+                    f"wire): buckets overlap the remaining backward"
+                    if bucketed else
+                    "end-of-step whole-tree blob: fully exposed "
+                    "(--dp_reduce_bucket_mb to overlap)")
+            add("DP grad reduce", "all-reduce", 1, nbytes, 2 * (dp - 1),
+                budget, note)
 
     total = sum(r["serialized_ms"] for r in records)
     hidden = sum(r["hidden_ms"] for r in records)
@@ -364,9 +411,14 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
             "config": {"tp": tp, "sp": sp, "tp_overlap": tp_overlap,
                        "dp": dp, "dp_bucket_mb": dp_bucket_mb,
                        "dp_reduce_dtype": dp_reduce_dtype,
+                       # the ZeRO stage the DP schedule was priced at
+                       # (ISSUE 9): <=1 all-reduce, 2 RS+param-AG, 3
+                       # per-layer AG + transpose RS
+                       "zero_stage": zero_stage,
                        # the attributable wire dtypes (ISSUE 8): what the
                        # DP reduce and the tp ring payloads actually carry
-                       "wire_dtype": dp_reduce_dtype,
+                       "wire_dtype": (dp_reduce_dtype if zero_stage < 3
+                                      else "f32"),
                        "tp_wire_dtype": ("int8" if tp_overlap == "ring_q"
                                          else "bf16")}}
 
@@ -380,7 +432,8 @@ def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
                 family: str = "llama", tp: int = 1, sp: bool = False,
                 tp_overlap: str = "off", dp: int = 1,
                 dp_bucket_mb: float = 0.0, dp_reduce_dtype: str = "f32",
-                measured_allreduce_us: Optional[float] = None) -> Dict:
+                measured_allreduce_us: Optional[float] = None,
+                zero_stage: int = 0) -> Dict:
     """The full report structure: analytic phase table, fwd/bwd/adam bucket
     sums, the per-collective COMM attribution (serialized vs hidden vs
     exposed ICI ms under the configured overlap knobs), ranked waste
@@ -403,7 +456,7 @@ def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
                             dp_reduce_dtype=dp_reduce_dtype, chip=chip,
                             family=family, remat=remat,
                             measured_allreduce_us=measured_allreduce_us,
-                            phase_ms=ms)
+                            phase_ms=ms, zero_stage=zero_stage)
     fwd_names = ["embed", "qkv_proj", "attention", "wo_proj", "ffn",
                  "norms_rope", "lm_head", "ce_loss"]
     buckets = {

@@ -49,6 +49,18 @@ rank (r + n-1-s) mod n.
   f32 scale per `quant.WIRE_GROUP` elements (<1% overhead), quarter the
   f32 wire bytes; the accumulate between hops stays f32 on-rank.
 
+* `ring_all_gather(x, axis, dim)` / `bucketed_reduce_scatter(...)` /
+  `quantized_reduce_scatter(...)` — the ZeRO-2/3 wires (training/zero.py).
+  `ring_all_gather` is the per-layer ZeRO-3 param gather: n-1 explicit
+  ppermute hops (overlappable like the matmul rings) whose TRANSPOSE is
+  the conjugate ring reduce-scatter — the backward's grad reduction,
+  derived by autodiff. `bucketed_reduce_scatter` is `bucketed_psum` with
+  the all-reduce swapped for one `psum_scatter` per bucket at IDENTICAL
+  bucket boundaries (half the wire bytes; each rank receives only its
+  per-leaf shards); its int8 wire routes through
+  `quantized_reduce_scatter`, which is `quantized_allreduce` stopped
+  after its reduce-scatter half.
+
 * `ag_matmul(..., quantized=True)` / `matmul_rs(..., quantized=True)` —
   the `tp_overlap='ring_q'` variants: the SAME ring schedules, but every
   ppermute payload is int8 codes + per-token-row scales. GATHER rings
@@ -106,6 +118,47 @@ def _slot_slice(a: jax.Array, slot: jax.Array, tl: int) -> jax.Array:
 def _slot_update(a: jax.Array, upd: jax.Array, slot: jax.Array,
                  tl: int) -> jax.Array:
     return lax.dynamic_update_slice_in_dim(a, upd, slot * tl, axis=-2)
+
+
+# ---------------------------------------------------------- ring_all_gather --
+
+def ring_all_gather(x: jax.Array, axis: str, dim: int = 0) -> jax.Array:
+    """Ring-decomposed all-gather of `x` along `dim` over `axis`: rank r's
+    chunk lands at slot r, so the result equals
+    `lax.all_gather(x, axis, axis=dim, tiled=True)` exactly (pure data
+    movement, no float reassociation).
+
+    Decomposed into n-1 explicit `ppermute` hops (ring convention of this
+    module: shift=+1, rank r holds rank (r-s)'s chunk after s hops) so
+    XLA's latency-hiding scheduler can slide each hop under whatever
+    compute is adjacent in the dataflow — the ZeRO-3 per-layer parameter
+    gather issues this inside the layer scan, where the previous layer's
+    matmuls are still in flight.
+
+    The TRANSPOSE is the conjugate ring reduce-scatter: ppermute transposes
+    to the reverse ppermute (value-correct under this container's legacy
+    shard_map — see training/zero.build_bucketed_grad_fn's note), so
+    differentiating through this gather hands each rank the dp-SUMMED
+    cotangent of its own chunk. That emergent reduce-scatter IS ZeRO-2/3's
+    gradient wire: half the all-reduce bytes, derived by autodiff instead
+    of hand-written.
+    """
+    n = _axis_size(axis)
+    if n == 1:
+        return x
+    idx = lax.axis_index(axis)
+    tl = x.shape[dim]
+    out = jnp.zeros((*x.shape[:dim], tl * n, *x.shape[dim + 1:]), x.dtype)
+    chunk = x
+    for s in range(n):
+        if s < n - 1:
+            nxt = ring_permute(chunk, axis, shift=1)
+        slot = jnp.mod(idx - s, n)  # origin rank of the chunk in hand
+        out = lax.dynamic_update_slice_in_dim(out, chunk, slot * tl,
+                                              axis=dim)
+        if s < n - 1:
+            chunk = nxt
+    return out
 
 
 # --------------------------------------------------------------- ag_matmul --
@@ -332,6 +385,71 @@ matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
 
 # ------------------------------------------------------ bucketed reduction --
 
+def _quantized_rs_blocks(blocks: jax.Array, axis: str,
+                         group: int = WIRE_GROUP) -> jax.Array:
+    """Reduce-scatter phase of the EQuARX int8 ring over pre-blocked rows.
+
+    `blocks` is (n, P) f32 with P a multiple of `group` (scale groups never
+    straddle callers' leaf boundaries); row j is this rank's contribution
+    to the block OWNED by rank j. The partial sum for block j starts at
+    rank j+1 and walks the +1 ring: each rank dequantizes the arriving
+    int8 partial, adds its OWN f32 row (the master accumulate — every
+    cross-rank addition happens in f32 on-rank), and requantizes for the
+    next hop. After n-1 hops this rank holds ITS block's full f32 sum.
+
+    Wire bytes: (n-1)/n x size x 1 byte + scales — exactly HALF the full
+    `quantized_allreduce` ring (whose all-gather phase moves the same
+    again). This half on its own is the ZeRO-2 int8 gradient wire: each
+    dp rank needs only the grad shard it updates, so the gather half is
+    simply never issued.
+    """
+    n = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    chunk = blocks.shape[1]
+
+    def block(j):
+        return lax.dynamic_slice_in_dim(blocks, j, 1, axis=0)[0]
+
+    # block j's partial starts at rank j+1, so this rank SEEDS block
+    # idx-1; at step s the arriving partial is for block idx-1-s and picks
+    # up this rank's contribution before the next hop
+    send = block(jnp.mod(idx - 1, n))
+    for s in range(1, n):
+        q, sc = quantize_groups(send, group)
+        q = ring_permute(q, axis, shift=1)
+        sc = ring_permute(sc, axis, shift=1)
+        arrived = dequantize_groups(q, sc, chunk, group)
+        send = arrived + block(jnp.mod(idx - 1 - s, n))
+    return send  # full f32 sum of block `idx`
+
+
+def quantized_reduce_scatter(blocks: jax.Array, axis: str,
+                             group: int = WIRE_GROUP) -> jax.Array:
+    """Block-scaled int8 ring reduce-scatter over ONE mesh axis.
+
+    `blocks` must be (n, P) with n = the axis size and P a multiple of
+    `group`; returns this rank's (P,) f32 summed row. This is
+    `quantized_allreduce` stopped after its reduce-scatter half — half
+    the wire bytes, because the caller (ZeRO-2's bucketed grad reduce)
+    only needs the shard it owns. Error: the circulating partial is
+    requantized n-1 times -> worst-case (n-1) x (group amax)/254
+    absolute, strictly tighter than the full ring's bound pinned in
+    tests/test_quant.py."""
+    n = _axis_size(axis)
+    if blocks.ndim != 2 or blocks.shape[0] != n:
+        raise ValueError(
+            f"quantized_reduce_scatter needs (axis_size, P) blocks; got "
+            f"shape {blocks.shape} on axis {axis!r} of size {n}")
+    if blocks.shape[1] % group:
+        raise ValueError(
+            f"quantized_reduce_scatter needs P % group == 0 so no scale "
+            f"group straddles a block boundary; got P={blocks.shape[1]}, "
+            f"group={group}")
+    if n == 1:
+        return blocks[0]
+    return _quantized_rs_blocks(blocks.astype(jnp.float32), axis, group)
+
+
 def _quantized_allreduce_axis(x: jax.Array, axis: str,
                               group: int = WIRE_GROUP) -> jax.Array:
     """Block-scaled int8 ring all-reduce of a flat f32 vector over ONE
@@ -361,20 +479,8 @@ def _quantized_allreduce_axis(x: jax.Array, axis: str,
     xp = jnp.pad(x.astype(jnp.float32), (0, n * chunk - size))
     blocks = xp.reshape(n, chunk)
 
-    def block(j):
-        return lax.dynamic_slice_in_dim(blocks, j, 1, axis=0)[0]
-
-    # -- reduce-scatter: block j's partial starts at rank j+1, so this
-    # rank SEEDS block idx-1; at step s the arriving partial is for block
-    # idx-1-s and picks up this rank's contribution before the next hop
-    send = block(jnp.mod(idx - 1, n))
-    for s in range(1, n):
-        q, sc = quantize_groups(send, group)
-        q = ring_permute(q, axis, shift=1)
-        sc = ring_permute(sc, axis, shift=1)
-        arrived = dequantize_groups(q, sc, chunk, group)
-        send = arrived + block(jnp.mod(idx - 1 - s, n))
-    own = send                               # full f32 sum of block `idx`
+    # -- reduce-scatter phase (shared with ZeRO-2's standalone RS wire)
+    own = _quantized_rs_blocks(blocks, axis, group)
 
     # -- all-gather: one quantization at the owner, n-1 hops
     q, sc = quantize_groups(own, group)
@@ -487,3 +593,85 @@ def bucketed_psum(tree, axes, bucket_mb: float = 25.0,
             out[i] = reduced[off:off + n].reshape(leaves[i].shape)
             off += n + leaf_pad(leaves[i])
     return jax.tree.unflatten(treedef, out)
+
+
+def bucketed_reduce_scatter(leaves, dims, axis, other_axes=(),
+                            bucket_mb: float = 25.0, reduce_dtype=None):
+    """ZeRO-2's gradient wire: sum each leaf over `axis` (+`other_axes`)
+    but return only THIS rank's `axis`-shard, sliced along `dims[i]`.
+
+    Same bucket boundaries as `bucketed_psum` (partitioned on full leaf
+    bytes, deterministic in list order) so swapping the all-reduce for the
+    reduce-scatter changes the wire, not the schedule — half the bytes at
+    identical buckets. Layout trick: each leaf moves its scatter dim to the
+    front and reshapes to (n, size/n), so row r is rank r's shard
+    flattened; buckets concatenate along the column axis and ONE
+    `lax.psum_scatter` over the whole bucket hands every rank exactly its
+    own per-leaf shards back. `reduce_dtype=jnp.bfloat16` casts the wire
+    only (grads return to f32 for the optimizer's master accumulate);
+    `jnp.int8` routes the bucket through `quantized_reduce_scatter` — the
+    EQuARX ring stopped after its reduce-scatter half — with leaves padded
+    to WIRE_GROUP multiples so no scale group straddles two leaves.
+
+    `other_axes` (e.g. ('cp',) or the SP tp axis for tp-replicated leaves)
+    are summed AFTER the scatter with a plain f32 psum of the 1/n shard —
+    the payload is already scattered, so compressing the residual sum
+    would spend extra roundings on 1/n of the bytes for ~nothing.
+
+    Returns the list of local shards: leaf i's shape with `dims[i]`
+    divided by the axis size (callers declare matching shard_map
+    out_specs). Every `dims[i]` must be divisible by the axis size —
+    callers pick dims with `training/zero`'s spec rule, which guarantees
+    it.
+    """
+    n = _axis_size(axis)
+    other_axes = tuple(other_axes)
+    int8_wire = (reduce_dtype is not None
+                 and jnp.dtype(reduce_dtype) == jnp.int8)
+    dtypes = {jnp.dtype(g.dtype) for g in leaves}
+    if len(dtypes) > 1:
+        # concatenate would silently promote a mixed bucket; grads are
+        # uniformly f32 here, so this is a misuse guard, not a code path
+        raise ValueError(f"bucketed_reduce_scatter buckets never mix "
+                         f"dtypes; got {sorted(map(str, dtypes))}")
+    prep = []
+    for g, d in zip(leaves, dims):
+        if g.shape[d] % n:
+            raise ValueError(
+                f"bucketed_reduce_scatter: leaf dim {d} of shape {g.shape} "
+                f"not divisible by axis {axis!r} size {n}")
+        a = jnp.moveaxis(g, d, 0)
+        shard_shape = (a.shape[0] // n,) + a.shape[1:]
+        m = a.reshape(n, -1)
+        pad = (-m.shape[1]) % WIRE_GROUP if int8_wire else 0
+        if pad:
+            m = jnp.pad(m, ((0, 0), (0, pad)))
+        prep.append((m, shard_shape, d))
+    # identical bucket boundaries to bucketed_psum: full leaf bytes
+    buckets = bucket_partition([g.size for g in leaves],
+                               int(bucket_mb * 2**20),
+                               leaves[0].dtype.itemsize if leaves else 4)
+    out = [None] * len(leaves)
+    for idxs in buckets:
+        flat = jnp.concatenate([prep[i][0] for i in idxs], axis=1)
+        if n == 1:
+            own = flat[0]
+        elif int8_wire:
+            own = quantized_reduce_scatter(flat, axis).astype(flat.dtype)
+        elif reduce_dtype is not None:
+            own = lax.psum_scatter(flat.astype(reduce_dtype), axis,
+                                   scatter_dimension=0, tiled=True)
+            own = own[0].astype(flat.dtype)
+        else:
+            own = lax.psum_scatter(flat, axis, scatter_dimension=0,
+                                   tiled=True)[0]
+        if other_axes:
+            own = lax.psum(own, other_axes)
+        off = 0
+        for i in idxs:
+            m, shard_shape, d = prep[i]
+            per = leaves[i].size // n
+            seg = own[off:off + per]
+            out[i] = jnp.moveaxis(seg.reshape(shard_shape), 0, d)
+            off += m.shape[1]
+    return out

@@ -46,7 +46,7 @@ from .training.metrics import (MetricsWriter, ProfilerTrace,
                                model_flops_per_step)
 from .training.optim import init_adam_state, schedule_lr
 from .training.train_step import (build_grad_accum_step, build_train_step,
-                                  build_train_step_multi)
+                                  build_train_step_multi, resolve_zero_stage)
 from .training.zero import zero1_moment_shardings
 
 
@@ -86,9 +86,24 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "bytes; pinned bounds in tests/test_quant.py); "
                         "requires --sequence_parallel. 'off' stays "
                         "bit-identical to the monolithic path")
+    g.add_argument("--zero", type=int, choices=[0, 1, 2, 3], default=None,
+                   help="ZeRO stage over the dp axis (training/zero.py): "
+                        "1 shards the Adam moments (2/dp optimizer memory); "
+                        "2 also reduce-SCATTERS the grads (half the DP wire "
+                        "bytes at identical buckets — implies the bucketed "
+                        "reducer; --dp_reduce_dtype int8 rides the "
+                        "quantized ring's reduce-scatter half) with one "
+                        "param all-gather per step; 3 also shards the "
+                        "PARAMS, gathered per layer on demand in fwd/bwd "
+                        "(peak param HBM full/dp + one layer — the unlock "
+                        "for models whose replica exceeds HBM x tp). "
+                        "Stages 2/3: dense models, --pp_size 1, and "
+                        "--sequence_parallel whenever tp > 1; stage 3 "
+                        "needs remat (dots/true/auto) and an f32 "
+                        "--dp_reduce_dtype")
     g.add_argument("--zero1", action="store_true",
-                   help="ZeRO-1: shard Adam moments over the dp axis "
-                        "(2/dp optimizer memory per device)")
+                   help="alias for --zero 1 (the PR 4-era flag): shard "
+                        "Adam moments over the dp axis")
     g.add_argument("--dp_reduce_bucket_mb", type=float, default=0.0,
                    help="bucketed DP/ZeRO-1 gradient reduction: issue one "
                         "psum per <= N-MiB bucket (overlappable with the "
@@ -395,12 +410,17 @@ def train(args: argparse.Namespace) -> dict:
                                                    preset.moe_capacity_factor),
                           vocab_size=vocab_size, maxlen=maxlen,
                           compute_dtype="bfloat16" if args.bf16 else "float32")
+        # ZeRO stage: explicit --zero wins; --zero1 is the stage-1 alias
+        # (the precedence rule lives in training/train_step.py)
+        zero_stage = resolve_zero_stage(args.zero, args.zero1)
         remat_key = args.remat
         if remat_key == "auto":
             from .training.memory import select_remat
             remat_key = select_remat(cfg, args.batch_size, maxlen,
                                      tp=args.tp_size,
-                                     world=mesh_cfg.world_size)
+                                     world=mesh_cfg.world_size,
+                                     zero_stage=zero_stage,
+                                     dp=args.dp_size)
         t_bucket = 0
         if args.seq_bucket:
             if args.seq_bucket < 1 or args.seq_bucket % 128:
@@ -425,11 +445,22 @@ def train(args: argparse.Namespace) -> dict:
                       f"tiles; CE masks the pad targets; tok/s and MFU "
                       f"count real tokens)")
         attn_t_real = maxlen if t_bucket else None
-        if args.dp_reduce_dtype != "f32" and not args.dp_reduce_bucket_mb:
+        if zero_stage == 3 and args.dp_reduce_dtype != "f32":
+            # before the generic needs-a-bucket check: adding a bucket
+            # would not make a compressed wire apply to stage 3
+            raise SystemExit(
+                f"--dp_reduce_dtype {args.dp_reduce_dtype} with --zero 3: "
+                f"the ZeRO-3 grad reduce-scatter rides the parameter "
+                f"all-gather's transpose (an f32 ppermute ring), so the "
+                f"compressed wire would silently not apply — use it with "
+                f"--zero 2, whose bucketed reduce-scatter carries the "
+                f"{args.dp_reduce_dtype} payload")
+        if (args.dp_reduce_dtype != "f32" and not args.dp_reduce_bucket_mb
+                and zero_stage != 2):
             raise SystemExit(f"--dp_reduce_dtype {args.dp_reduce_dtype} "
                              f"needs --dp_reduce_bucket_mb > 0 (the "
                              f"compressed wire is a property of the "
-                             f"bucketed reducer)")
+                             f"bucketed reducer; --zero 2 implies it)")
         if args.dp_reduce_bucket_mb and args.pp_size > 1:
             raise SystemExit("--dp_reduce_bucket_mb needs --pp_size 1 "
                              "(pp-replicated leaves' reduction axes depend "
@@ -438,6 +469,37 @@ def train(args: argparse.Namespace) -> dict:
             raise SystemExit("--dp_reduce_bucket_mb does not compose with "
                              "MoE (expert grads are ep-sharded, not "
                              "batch-replicated)")
+        if zero_stage >= 2:
+            # the stage-2/3 grad paths ride the bucketed reducer's scope
+            # (training/zero.py) — refuse HERE with actionable messages
+            # instead of a ValueError mid-build
+            if cfg.num_experts:
+                raise SystemExit(
+                    f"--zero {zero_stage} does not compose with MoE: expert "
+                    f"grads are ep-sharded, not batch-replicated — use "
+                    f"--zero 1 (moment sharding only) for MoE runs")
+            if args.pp_size > 1:
+                raise SystemExit(
+                    f"--zero {zero_stage} needs --pp_size 1: non-layer "
+                    f"params are pp-replicated and their reduction axes "
+                    f"depend on the pipeline head layout — use --zero 1 "
+                    f"under pp")
+            if args.tp_size > 1 and not args.sequence_parallel:
+                raise SystemExit(
+                    f"--zero {zero_stage} with --tp_size {args.tp_size} "
+                    f"needs --sequence_parallel: the non-SP path "
+                    f"all-reduces inside every row-parallel layer, so "
+                    f"per-shard cotangent bookkeeping is depth-dependent "
+                    f"(turn SP on, or drop to --zero 1)")
+        if zero_stage == 3 and remat_key == "false":
+            raise SystemExit(
+                "--zero 3 needs rematerialisation (--remat dots/true/"
+                "auto): without remat, autodiff saves every layer's "
+                "GATHERED weights as backward residuals, recreating the "
+                "full param replica the stage exists to eliminate")
+        if zero_stage == 2 and not args.dp_reduce_bucket_mb:
+            print("zero 2: grads reduce-scatter in 25 MiB buckets "
+                  "(--dp_reduce_bucket_mb to tune)")
         if args.family == "gpt2":
             from .models.gpt2 import GPT2Transformer
             model = GPT2Transformer(cfg, tp_size=args.tp_size,
@@ -482,7 +544,8 @@ def train(args: argparse.Namespace) -> dict:
               f"vocab={vocab_size}, "
               f"mesh=dp{args.dp_size} x pp{args.pp_size} x cp{args.cp_size} x "
               f"ep{args.ep_size} x tp{args.tp_size}, "
-              f"compute={cfg.compute_dtype}")
+              f"compute={cfg.compute_dtype}"
+              + (f", zero={zero_stage}" if zero_stage else ""))
         opt_state = init_adam_state(params)
         start_step = 0
         if args.resume:
@@ -527,10 +590,17 @@ def train(args: argparse.Namespace) -> dict:
                         opt_state = _map_moments(opt_state, model.from_canonical)
                     print(f"resumed from iter {start_step} in {args.save_dir}")
 
-        shardings = model.shardings(mesh)
+        if zero_stage >= 3:
+            # ZeRO-3: params REST dp-sharded (zero3_specs); the step's
+            # forward gathers each layer on demand. Moments share the
+            # layout, so the Adam update is fully local per shard.
+            from .training.zero import zero3_shardings
+            shardings = zero3_shardings(model, mesh)
+        else:
+            shardings = model.shardings(mesh)
         params = jax.device_put(params, shardings)
-        moment_sh = (zero1_moment_shardings(model, mesh) if args.zero1
-                     else shardings)
+        moment_sh = (zero1_moment_shardings(model, mesh)
+                     if zero_stage in (1, 2) else shardings)
         opt_state = jax.device_put(
             opt_state, opt_state.__class__(
                 step=jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec()),
@@ -546,8 +616,9 @@ def train(args: argparse.Namespace) -> dict:
                   f"--steps_per_dispatch {spd}: the final "
                   f"{args.max_steps % spd}-step tail triggers a one-time XLA "
                   f"recompile (pick a divisible pair to avoid it)")
-        builder_kwargs = dict(zero1=args.zero1,
-                              moment_shardings=moment_sh if args.zero1 else None,
+        builder_kwargs = dict(zero=zero_stage,
+                              moment_shardings=(moment_sh if zero_stage
+                                                else None),
                               with_grad_norm=True,
                               dp_reduce_bucket_mb=args.dp_reduce_bucket_mb,
                               dp_reduce_dtype={"bf16": jnp.bfloat16,
@@ -742,7 +813,8 @@ def train(args: argparse.Namespace) -> dict:
                 args.save_dir, step, avg, save_params,
                 model.canonical_specs(), args.tp_size, save_opt,
                 reserve_last_n=args.reserve_last_n_ckpts,
-                async_write=True, tracer=observer.tracer)
+                async_write=True, tracer=observer.tracer,
+                zero_stage=zero_stage)
             last_saved = step
 
         def shutdown_save(step):

@@ -97,13 +97,35 @@ def _shard_slice(arr: np.ndarray, spec: P, rank: int, tp_size: int) -> np.ndarra
     return arr[tuple(sl)]
 
 
+def _get_leafwise(tree: Any) -> Any:
+    """device->host one LEAF at a time (np.asarray assembles each leaf's
+    addressable shards; dp/tp-sharded global arrays come back as their
+    full numpy values with no device-side collective). The whole-tree
+    `jax.device_get` it replaces materialised every transfer before the
+    first byte was written; leaf-wise streaming keeps the transient
+    device->host working set to one leaf, which is what lets dp-sharded
+    ZeRO-2/3 state save through this path without a full-tree gather
+    stall (the npz format still holds global values, so any mesh/stage
+    can reload the file — resharding happens at device_put)."""
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
 def save_checkpoint(save_dir: str, step: int, avg_loss: float, params: Any,
                     specs: Any, tp_size: int,
                     opt_state: Optional[AdamState] = None,
                     reserve_last_n: int = -1,
                     async_write: bool = False,
-                    tracer=None) -> "List[str] | AsyncSaveHandle":
+                    tracer=None,
+                    zero_stage: int = 0) -> "List[str] | AsyncSaveHandle":
     """Write one npz per TP rank; returns the paths written.
+
+    Works unchanged for ZeRO-sharded state (dp-sharded moments at stage
+    1/2, dp-sharded params+moments at stage 3): leaves stream through the
+    host one at a time (`_get_leafwise`) and land as GLOBAL arrays, so
+    the on-disk format stays mesh- and stage-independent — a dp4 ZeRO-3
+    run reloads on a dp2 ZeRO-1 mesh by plain device_put. `zero_stage` is
+    recorded as `__zero_stage__` metadata (observability only; loaders
+    ignore it).
 
     `async_write=True` returns an `AsyncSaveHandle` instead: the arrays are
     snapshotted on-device (one jitted copy, so later donated train steps
@@ -129,14 +151,13 @@ def save_checkpoint(save_dir: str, step: int, avg_loss: float, params: Any,
         return paths
 
     def _write(params, opt_state) -> List[str]:
-        params_np = jax.tree.map(np.asarray, jax.device_get(params))
+        params_np = _get_leafwise(params)
         flat_p = _flatten(params_np, "param")
         flat_s = _flatten(specs, "param")
         flat_opt: Dict[str, Any] = {}
         if opt_state is not None:
-            opt_np = jax.device_get(opt_state)
-            flat_opt.update(_flatten(jax.tree.map(np.asarray, opt_np.mu), "mu"))
-            flat_opt.update(_flatten(jax.tree.map(np.asarray, opt_np.nu), "nu"))
+            flat_opt.update(_flatten(_get_leafwise(opt_state.mu), "mu"))
+            flat_opt.update(_flatten(_get_leafwise(opt_state.nu), "nu"))
             # moments shard exactly like their params
             flat_s.update({k.replace("param", "mu", 1): v for k, v in
                            _flatten(specs, "param").items()})
@@ -152,6 +173,7 @@ def save_checkpoint(save_dir: str, step: int, avg_loss: float, params: Any,
             shard["__step__"] = np.asarray(step, np.int64)
             shard["__tp_size__"] = np.asarray(tp_size, np.int64)
             shard["__has_opt__"] = np.asarray(opt_state is not None)
+            shard["__zero_stage__"] = np.asarray(zero_stage, np.int64)
             path = os.path.join(
                 save_dir, f"tprank-{rank}_iter-{step}_loss-{avg_loss:.4f}.npz")
             # Atomic publish: a hard kill mid-write (preemption grace

@@ -25,6 +25,17 @@ The optimizer state pytree mirrors the param pytree, so the same
 PartitionSpecs shard it: each TP rank keeps Adam moments only for its own
 weight shard — the same property the reference gets from per-rank
 `optim.Adam(model.parameters())` (`train.py:83`).
+
+ZeRO contract (training/zero.py): `adam_update` is deliberately
+stage-oblivious. Every per-leaf operation below is elementwise, so when
+the moments (ZeRO-1), the grads (ZeRO-2, from the bucketed
+reduce-scatter) and/or the params (ZeRO-3) arrive dp-sharded on MATCHING
+layouts, XLA computes the update on whichever dp shard owns the data —
+the sharded-weight-update schedule falls out of the layouts alone, and
+this module cannot drift out of sync with a stage it never sees. The two
+cross-leaf reductions (`global_norm`, `clip_by_global_norm`) are global
+sums at the jit level, so the clip threshold and the logged grad norm are
+stage-invariant (XLA partial-sums per shard and all-reduces one scalar).
 """
 
 from __future__ import annotations

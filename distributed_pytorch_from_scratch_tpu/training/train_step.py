@@ -20,15 +20,56 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..config import OptimizerConfig, TrainConfig
 from ..models.transformer import Transformer
 from .optim import AdamState, adam_update, global_norm
-from .zero import build_bucketed_grad_fn, zero1_moment_shardings
+from .zero import (build_bucketed_grad_fn, build_zero3_grad_fn,
+                   zero1_moment_shardings, zero3_shardings)
+
+
+def resolve_zero_stage(zero, zero1: bool = False) -> int:
+    """The ZeRO stage from the `zero`/`zero1` kwargs: explicit `zero`
+    wins; `zero1=True` is the PR 4-era alias for stage 1. The ONE owner
+    of the precedence rule — the builders and the train CLI both resolve
+    through here."""
+    if zero is not None:
+        stage = int(zero)
+        if stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero stage must be 0..3, got {zero!r}")
+        return stage
+    return 1 if zero1 else 0
+
+
+_resolve_stage = resolve_zero_stage  # internal alias used by the builders
 
 
 def _make_grad_fn(model: Transformer, mesh, loss_mode: str,
-                  dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None):
+                  dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None,
+                  zero_stage: int = 0):
     """(params, ids, tgt, pos) -> (loss, grads): the transpose-derived
     whole-tree reducer by default; with dp_reduce_bucket_mb > 0 the
     bucketed-overlap reducer (training/zero.build_bucketed_grad_fn — DP
-    psums issued per size-bounded bucket, optionally bf16 on the wire)."""
+    psums issued per size-bounded bucket, optionally bf16/int8 on the
+    wire). zero_stage=2 swaps the bucketed all-reduce for the bucketed
+    REDUCE-SCATTER (grads come back dp-sharded, half the wire bytes);
+    zero_stage=3 is the gather-on-demand path (params AND grads dp-sharded,
+    training/zero.build_zero3_grad_fn) — both default bucket_mb to 25 when
+    the caller left it 0, since their wire IS the bucketed one."""
+    if zero_stage >= 3:
+        if dp_reduce_dtype is not None:
+            # the CLIs refuse this with their own message; the builder is
+            # the backstop so a library caller can't silently lose the
+            # compressed wire it asked for
+            raise ValueError(
+                "dp_reduce_dtype with zero stage 3: the ZeRO-3 grad "
+                "reduce-scatter rides the parameter all-gather's "
+                "transpose (an f32 ppermute ring), so a compressed wire "
+                "would silently not apply — use stage 2, whose bucketed "
+                "reduce-scatter carries the compressed payload")
+        return build_zero3_grad_fn(model, mesh, loss_mode,
+                                   bucket_mb=dp_reduce_bucket_mb or 25.0)
+    if zero_stage == 2:
+        return build_bucketed_grad_fn(model, mesh, loss_mode,
+                                      bucket_mb=dp_reduce_bucket_mb or 25.0,
+                                      reduce_dtype=dp_reduce_dtype,
+                                      zero_stage=2)
     if dp_reduce_bucket_mb:
         return build_bucketed_grad_fn(model, mesh, loss_mode,
                                       bucket_mb=dp_reduce_bucket_mb,
@@ -38,7 +79,8 @@ def _make_grad_fn(model: Transformer, mesh, loss_mode: str,
 
 def _step_body(model: Transformer, mesh, ocfg: OptimizerConfig,
                loss_mode: str, with_grad_norm: bool = False,
-               dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None):
+               dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None,
+               zero_stage: int = 0):
     """The one train-step body shared by both builders: grad + Adam/OneCycle.
     Keeping it single-sourced means the scanned (multi-step) program can
     never silently diverge from the per-step one.
@@ -48,7 +90,8 @@ def _step_body(model: Transformer, mesh, ocfg: OptimizerConfig,
     same program, fetched only at the loop's logging-interval D2H, so the
     sentinel costs no extra syncs."""
     grad_fn = _make_grad_fn(model, mesh, loss_mode,
-                            dp_reduce_bucket_mb, dp_reduce_dtype)
+                            dp_reduce_bucket_mb, dp_reduce_dtype,
+                            zero_stage=zero_stage)
 
     def step(params, opt_state: AdamState, input_ids, target_ids,
              position_ids):
@@ -63,15 +106,28 @@ def _step_body(model: Transformer, mesh, ocfg: OptimizerConfig,
     return step
 
 
-def _jit_with_zero1(fn, model, mesh, zero1, moment_shardings, loss_sharding):
-    """jit `fn` with donated params/opt state; under zero1, pin the Adam
-    moments to dp-sharded layouts (training/zero.py) so XLA computes each
-    moment/param update on the dp shard that owns it and all-gathers the
-    fresh params — ZeRO-1, derived by the partitioner. `moment_shardings`
-    lets the caller pass the tree it already built (from
-    `zero1_moment_shardings`) for `device_put`-ing the initial state, so
-    there is exactly one source of the moment layout; derived here when
-    omitted.
+def _jit_with_zero(fn, model, mesh, zero_stage, moment_shardings,
+                   loss_sharding):
+    """jit `fn` with donated params/opt state; under a ZeRO stage, pin the
+    state to its sharded layouts (training/zero.py) so XLA derives the
+    stage's schedule:
+
+    * stage 1 — Adam moments dp-sharded, params replicated: the
+      partitioner computes each moment/param update on the owning dp shard
+      and all-gathers the fresh params.
+    * stage 2 — same out_shardings as stage 1; the grads ARRIVE dp-sharded
+      from the bucketed reduce-scatter (zero1-layout, so the update is
+      local to the moment shard) and the params' end-of-step all-gather
+      replaces the grad reduction's gather half.
+    * stage 3 — params AND moments pinned to `zero3_shardings`: grads come
+      back on the same layout from the gather transposes, the Adam update
+      is fully local (no collective at all in the optimizer), and the
+      fresh params REST sharded — the next step's forward re-gathers per
+      layer.
+
+    `moment_shardings` lets the caller pass the tree it already built for
+    `device_put`-ing the initial state, so there is exactly one source of
+    the moment layout; derived here when omitted.
 
     The ids/tgt/pos batch buffers are deliberately NOT donated: XLA
     donation is strictly input->output aliasing, and the int32 batch
@@ -82,11 +138,16 @@ def _jit_with_zero1(fn, model, mesh, zero1, moment_shardings, loss_sharding):
     un-aliasing the Adam moments) shows up in the train log's compile
     report instead of as a quiet 2x optimizer-state footprint."""
     donate = (0, 1)
-    if not zero1:
+    if not zero_stage:
         return jax.jit(fn, donate_argnums=donate)
-    param_sh = model.shardings(mesh)
-    moment_sh = (moment_shardings if moment_shardings is not None
-                 else zero1_moment_shardings(model, mesh))
+    if zero_stage >= 3:
+        param_sh = zero3_shardings(model, mesh)
+        moment_sh = (moment_shardings if moment_shardings is not None
+                     else param_sh)
+    else:
+        param_sh = model.shardings(mesh)
+        moment_sh = (moment_shardings if moment_shardings is not None
+                     else zero1_moment_shardings(model, mesh))
     scalar = NamedSharding(mesh, P())
     opt_sh = AdamState(step=scalar, mu=moment_sh, nu=moment_sh)
 
@@ -105,7 +166,8 @@ def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
                      loss_mode: str = "vocab_parallel",
                      zero1: bool = False, moment_shardings=None,
                      with_grad_norm: bool = False,
-                     dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None):
+                     dp_reduce_bucket_mb: float = 0.0, dp_reduce_dtype=None,
+                     zero: "int | None" = None):
     """Returns jitted
     (params, opt_state, input_ids, target_ids, position_ids)
       -> (params, opt_state, loss)            [default]
@@ -114,14 +176,21 @@ def build_train_step(model: Transformer, mesh, ocfg: OptimizerConfig,
     `dp_reduce_bucket_mb > 0` swaps the whole-tree DP grad reduction for
     the bucketed-overlap reducer (with `dp_reduce_dtype=jnp.bfloat16` for
     a compressed wire) — see training/zero.build_bucketed_grad_fn.
+
+    `zero` picks the ZeRO stage (0..3; supersedes the `zero1` bool, kept
+    as an alias for stage 1). Stage 2 routes grads through the bucketed
+    reduce-scatter; stage 3 additionally expects params (and the initial
+    moments) device_put at `zero3_shardings` — they rest dp-sharded and
+    the forward gathers per layer.
     """
+    stage = _resolve_stage(zero, zero1)
     step = _step_body(model, mesh, ocfg, loss_mode,
                       with_grad_norm=with_grad_norm,
                       dp_reduce_bucket_mb=dp_reduce_bucket_mb,
-                      dp_reduce_dtype=dp_reduce_dtype)
+                      dp_reduce_dtype=dp_reduce_dtype, zero_stage=stage)
     out_spec = (P(), P()) if with_grad_norm else P()
-    return _jit_with_zero1(step, model, mesh, zero1, moment_shardings,
-                           out_spec)
+    return _jit_with_zero(step, model, mesh, stage, moment_shardings,
+                          out_spec)
 
 
 def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
@@ -129,7 +198,8 @@ def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
                            zero1: bool = False, moment_shardings=None,
                            with_grad_norm: bool = False,
                            dp_reduce_bucket_mb: float = 0.0,
-                           dp_reduce_dtype=None):
+                           dp_reduce_dtype=None,
+                           zero: "int | None" = None):
     """Multi-step-per-dispatch variant: one jitted program runs
     `lax.scan` over a leading steps axis of the batch.
 
@@ -145,10 +215,11 @@ def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
     one `optimizer.step()` per Python iteration
     (`/root/reference/train.py:94-109`).
     """
+    stage = _resolve_stage(zero, zero1)
     step = _step_body(model, mesh, ocfg, loss_mode,
                       with_grad_norm=with_grad_norm,
                       dp_reduce_bucket_mb=dp_reduce_bucket_mb,
-                      dp_reduce_dtype=dp_reduce_dtype)
+                      dp_reduce_dtype=dp_reduce_dtype, zero_stage=stage)
 
     def multi_step(params, opt_state: AdamState, input_ids, target_ids,
                    position_ids):
@@ -162,8 +233,8 @@ def build_train_step_multi(model: Transformer, mesh, ocfg: OptimizerConfig,
         return params, opt_state, outs
 
     out_spec = (P(None), P(None)) if with_grad_norm else P(None)
-    return _jit_with_zero1(multi_step, model, mesh, zero1, moment_shardings,
-                           out_spec)
+    return _jit_with_zero(multi_step, model, mesh, stage, moment_shardings,
+                          out_spec)
 
 
 def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
@@ -171,7 +242,8 @@ def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
                           zero1: bool = False, moment_shardings=None,
                           with_grad_norm: bool = False,
                           dp_reduce_bucket_mb: float = 0.0,
-                          dp_reduce_dtype=None):
+                          dp_reduce_dtype=None,
+                          zero: "int | None" = None):
     """Gradient accumulation: ONE optimizer step from the MEAN of the
     microbatch gradients.
 
@@ -186,8 +258,10 @@ def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
     without scaling HBM. The reference has no accumulation (SURVEY
     non-goals); this is the TPU-native extension of its loop.
     """
+    stage = _resolve_stage(zero, zero1)
     grad_fn = _make_grad_fn(model, mesh, loss_mode,
-                            dp_reduce_bucket_mb, dp_reduce_dtype)
+                            dp_reduce_bucket_mb, dp_reduce_dtype,
+                            zero_stage=stage)
 
     def step(params, opt_state: AdamState, input_ids, target_ids,
              position_ids):
@@ -210,7 +284,7 @@ def build_grad_accum_step(model: Transformer, mesh, ocfg: OptimizerConfig,
         return params, opt_state, out
 
     out_spec = (P(), P()) if with_grad_norm else P()
-    return _jit_with_zero1(step, model, mesh, zero1, moment_shardings,
-                           out_spec)
+    return _jit_with_zero(step, model, mesh, stage, moment_shardings,
+                          out_spec)
 
 

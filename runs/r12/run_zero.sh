@@ -1,0 +1,59 @@
+#!/bin/bash
+# Round-12 ZeRO-ladder session (ISSUE 9): stage {1,2,3} x wire {f32,int8}
+# priced against each other on the same model/buckets.
+#   1. stage sweep — the 45m overlap config (dp2xtp4 + SP, the PR 4/8
+#      mesh) at zero 1 (all-reduce grads), zero 2 (bucketed
+#      reduce-scatter: HALF the DP wire bytes at identical buckets, f32
+#      and int8 — the int8 arm rides PR 8's quantized ring stopped at its
+#      reduce-scatter half), and zero 3 (params gathered per layer on
+#      demand; grad reduction riding the gather transposes — f32 only,
+#      the CLI refuses a compressed wire rather than silently dropping
+#      it). Same model, same buckets: the tok/s deltas ARE the schedule,
+#      and every record carries zero_stage + the MEASURED
+#      param_bytes_per_device (the stage-3 memory claim lands as data).
+#      Needs >= 8 chips; a dp2xtp1 fallback covers the ladder on smaller
+#      multi-chip windows; single-chip sessions skip with a logged note.
+#   2. breakdown arm — the comm attribution pricing the zero-2
+#      reduce-scatter at half the all-reduce bytes + the param all-gather
+#      (RS/AG records in the artifact, zero_stage in the JSON), so the
+#      halved wire is SHOWN in the record, not asserted.
+# Weights are random inits (wire/schedule effects are value-free) and the
+# math-parity story is pinned by CPU tests (tests/test_zero.py), so no
+# checkpoint transfer burns window. Idempotent; reuses the round-5
+# session helpers (step/bench_line artifact guards, SESSION_DEADLINE
+# chokepoint via scripts/run_step.py).
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r12
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r12 zero pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 1. the stage ladder on the dp2xtp4 overlap config (>= 8 chips), else
+#    the dp2 fallback (>= 2 chips), else skip with a note
+if timeout 120 python -c "import jax, sys; sys.exit(0 if jax.device_count() >= 8 else 1)"; then
+  bench_line 45mzero1f32  1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 4 --sequence_parallel --zero 1 --dp_reduce_bucket_mb 25 --steps_per_dispatch 16
+  bench_line 45mzero1int8 1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 4 --sequence_parallel --zero 1 --dp_reduce_bucket_mb 25 --dp_reduce_dtype int8 --steps_per_dispatch 16
+  bench_line 45mzero2f32  1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 4 --sequence_parallel --zero 2 --steps_per_dispatch 16
+  bench_line 45mzero2int8 1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 4 --sequence_parallel --zero 2 --dp_reduce_dtype int8 --steps_per_dispatch 16
+  bench_line 45mzero3     1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 4 --sequence_parallel --zero 3 --steps_per_dispatch 16
+  # 2. attribution evidence: RS priced at half AR bytes + the param AG,
+  #    zero_stage + param_bytes_per_device in the record
+  bench_line 45mzerobreak 1200 --model 45m --remat dots --seq_bucket 128 --dp 2 --tp 4 --sequence_parallel --zero 2 --breakdown
+elif timeout 120 python -c "import jax, sys; sys.exit(0 if jax.device_count() >= 2 else 1)"; then
+  bench_line 45mzero1f32  1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 1 --zero 1 --dp_reduce_bucket_mb 25 --steps_per_dispatch 16
+  bench_line 45mzero1int8 1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 1 --zero 1 --dp_reduce_bucket_mb 25 --dp_reduce_dtype int8 --steps_per_dispatch 16
+  bench_line 45mzero2f32  1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 1 --zero 2 --steps_per_dispatch 16
+  bench_line 45mzero2int8 1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 1 --zero 2 --dp_reduce_dtype int8 --steps_per_dispatch 16
+  bench_line 45mzero3     1200 --model 45m --remat auto --seq_bucket 128 --dp 2 --tp 1 --zero 3 --steps_per_dispatch 16
+  bench_line 45mzerobreak 1200 --model 45m --remat dots --seq_bucket 128 --dp 2 --tp 1 --zero 2 --breakdown
+else
+  echo "r12: single-chip session — ZeRO ladder skipped (needs >= 2 chips for a dp axis)" | tee -a "$R/session.log"
+fi
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r12 zero done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -63,9 +63,12 @@ def test_bench_emits_one_json_line(extra):
     lines = [l for l in p.stdout.strip().splitlines() if l.strip()]
     assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
     rec = json.loads(lines[0])
-    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "zero_stage", "param_bytes_per_device"}
     assert rec["unit"] == "tokens/sec/chip"
     assert rec["value"] > 0
+    assert rec["zero_stage"] == 0          # no --zero flag staged here
+    assert rec["param_bytes_per_device"] > 0
 
 
 def test_breakdown_bench_emits_one_json_line():
@@ -89,7 +92,8 @@ def test_breakdown_bench_emits_one_json_line():
     assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
     rec = json.loads(lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "components", "wire_dtype", "attribution"}
+                        "components", "wire_dtype", "attribution",
+                        "zero_stage", "param_bytes_per_device"}
     assert rec["unit"] == "ms/step"
     assert rec["wire_dtype"] == "f32"   # default: uncompressed DP wire
     comp = rec["components"]
@@ -133,7 +137,8 @@ def test_breakdown_analytic_emits_one_json_line():
     assert len(lines) == 1, f"stdout must be ONE JSON line, got: {p.stdout!r}"
     rec = json.loads(lines[0])
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "wire_dtype", "tp_overlap", "comm", "suspects"}
+                        "wire_dtype", "tp_overlap", "comm", "suspects",
+                        "zero_stage"}
     assert rec["unit"] == "ms/step (analytic)"
     assert rec["value"] > 0
     names = [s["name"] for s in rec["suspects"]]

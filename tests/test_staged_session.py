@@ -25,13 +25,15 @@ R5 = os.path.join(REPO, "runs", "r5")
 # every staged session dir gets preflighted (r6 stages the fast-45m pass,
 # r7 the comm-overlap A/B, r8 the serving loadgen sweep, r9 the paged
 # serving-v2 sweep + slot-vs-paged A/B, r10 the speculative k-sweep +
-# fused-sampler ablation, r11 the int8 wire sweep + int8-KV serving arms)
+# fused-sampler ablation, r11 the int8 wire sweep + int8-KV serving arms,
+# r12 the ZeRO stage x wire ladder + RS/AG breakdown arm)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
                             os.path.join(REPO, "runs", "r9"),
                             os.path.join(REPO, "runs", "r10"),
-                            os.path.join(REPO, "runs", "r11"))
+                            os.path.join(REPO, "runs", "r11"),
+                            os.path.join(REPO, "runs", "r12"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
