@@ -195,6 +195,19 @@ def parse_args(argv=None):
                         "the paged/speculative arms (dequant-on-use "
                         "inside the decode/prefill programs; "
                         "ops/quant.quantize_decode_params)")
+    p.add_argument("--trace_requests", action="store_true",
+                   help="--serving: per-request span timelines on the "
+                        "paged arm (obs/reqtrace.py) — request_trace "
+                        "events + the k-worst exemplar timelines land in "
+                        "--obs_dir so an SLO-tail number is explainable, "
+                        "not just reported")
+    p.add_argument("--flight_records", action="store_true",
+                   help="--serving: anomaly flight recorder on the paged "
+                        "arm (obs/flight.py) — PoolExhausted preemptions "
+                        "dump flightdump_*.json to --obs_dir")
+    p.add_argument("--obs_dir", default="bench_obs",
+                   help="--trace_requests/--flight_records output dir "
+                        "(metrics.jsonl + trace + flight dumps)")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="--serving: add a SPECULATIVE arm to the A/B — a "
                         "'tiny'-preset drafter proposes K tokens per round, "
@@ -211,6 +224,9 @@ def parse_args(argv=None):
         p.error("--speculate is a --serving mode")
     if args.kv_dtype != "native" and not args.serving:
         p.error("--kv_dtype is a --serving knob (the paged KV pool)")
+    if (args.trace_requests or args.flight_records) and not args.serving:
+        p.error("--trace_requests/--flight_records are --serving knobs "
+                "(training runs get them from train.py's observer)")
     if args.decode_weight_dtype != "native" and not args.serving:
         p.error("--decode_weight_dtype is a --serving knob")
     if args.remat is None:
@@ -524,13 +540,44 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     native_pages = max(-(-buf_len // args.page_size),
                        (args.slots * buf_len) // args.page_size)
     kv_capacity_ratio = round(num_pages / max(native_pages, 1), 3)
-    paged = PagedEngine(
-        model, mesh, params, num_slots=args.serve_requests, buf_len=buf_len,
-        eos_id=eos, page_size=args.page_size, num_pages=num_pages,
-        prefill_chunk=args.prefill_chunk, kv_dtype=kv_dtype,
-        decode_weight_dtype=wdtype)
-    paged_summary = run_loadgen(paged, burst())
-    paged_rate = paged_summary["tokens_per_sec"]
+    # observability on the PAGED arm (the headline engine): per-request
+    # timelines + flight ring under --obs_dir, refused loudly when the
+    # dir cannot take writes (a silently traceless traced bench is worse
+    # than none)
+    obs_tracer = obs_writer = obs_rt = obs_flight = None
+    if args.trace_requests or args.flight_records:
+        from distributed_pytorch_from_scratch_tpu.obs import (
+            FlightRecorder, RequestTracer, SpanTracer)
+        from distributed_pytorch_from_scratch_tpu.serving.serve import (
+            require_writable_dir)
+        from distributed_pytorch_from_scratch_tpu.training.metrics import (
+            MetricsWriter)
+        require_writable_dir(args.obs_dir,
+                             "--trace_requests/--flight_records")
+        obs_tracer = SpanTracer(args.obs_dir, process_name="bench-serving")
+        obs_writer = MetricsWriter(args.obs_dir, process_index=0)
+        if args.flight_records:
+            obs_flight = FlightRecorder(args.obs_dir)
+        if args.trace_requests:
+            obs_rt = RequestTracer(writer=obs_writer, tracer=obs_tracer,
+                                   flight=obs_flight)
+    try:
+        paged = PagedEngine(
+            model, mesh, params, num_slots=args.serve_requests,
+            buf_len=buf_len, eos_id=eos, page_size=args.page_size,
+            num_pages=num_pages, prefill_chunk=args.prefill_chunk,
+            kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
+            tracer=obs_tracer, writer=obs_writer,
+            request_tracer=obs_rt, flight=obs_flight)
+        paged_summary = run_loadgen(paged, burst())
+        paged_rate = paged_summary["tokens_per_sec"]
+    finally:
+        # a mid-run failure is exactly when the trace matters: finalise
+        # trace.json + flush the events before the exception propagates
+        if obs_tracer is not None:
+            obs_tracer.close()
+        if obs_writer is not None:
+            obs_writer.close()
 
     # (a') the speculative arm at the SAME byte budget: the drafter's pages
     # buy acceptance, not capacity, so they are paid for by SHRINKING the
@@ -677,6 +724,13 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         "decode_weight_dtype": args.decode_weight_dtype,
         "num_pages": num_pages,
         "kv_capacity_ratio": kv_capacity_ratio,
+        # ISSUE 10: where the per-request timelines / flight dumps landed
+        **({"obs_dir": args.obs_dir} if (args.trace_requests
+                                         or args.flight_records) else {}),
+        **({"worst_ttft_rids": paged_summary["worst_ttft_rids"]}
+           if "worst_ttft_rids" in paged_summary else {}),
+        **({"flight_dumps": list(obs_flight.dumps)}
+           if obs_flight is not None else {}),
         **spec_rec,
         "ttft_ms_p50": paged_summary["ttft_ms_p50"],
         "ttft_ms_p95": paged_summary["ttft_ms_p95"],

@@ -1,21 +1,30 @@
 """Observability: step-timeline tracing, goodput accounting, compiled-
-program introspection, a training-health sentinel, and a hang watchdog.
+program introspection, a training-health sentinel, a hang watchdog, and
+(v2, ISSUE 10) per-request tracing, an anomaly flight recorder, and
+cross-rank skew attribution.
 
 See docs/OBSERVABILITY.md for the operator's view (trace format, goodput
-buckets, sentinel thresholds).
+buckets, sentinel thresholds, flight-dump walkthrough).
 """
 
-from .attribution import (attribution, flash_tile_stats, format_attribution)
+from .attribution import (attribution, flash_tile_stats, format_attribution,
+                          rank_skew)
+from .flight import FlightRecorder
 from .goodput import BUCKETS, GoodputMeter
 from .introspect import analyze_compiled, format_analysis, parse_collectives
 from .observer import TrainObserver
+from .reqtrace import RequestTracer
+from .schema import (EVENT_REQUIRED, EVENT_SCHEMA_VERSION, validate_jsonl,
+                     validate_record)
 from .sentinel import HealthSentinel, TrainingHealthError
 from .trace import SpanTracer
 from .watchdog import HangWatchdog
 
 __all__ = [
-    "BUCKETS", "GoodputMeter", "HangWatchdog", "HealthSentinel",
+    "BUCKETS", "EVENT_REQUIRED", "EVENT_SCHEMA_VERSION", "FlightRecorder",
+    "GoodputMeter", "HangWatchdog", "HealthSentinel", "RequestTracer",
     "SpanTracer", "TrainObserver", "TrainingHealthError",
     "analyze_compiled", "attribution", "flash_tile_stats",
     "format_analysis", "format_attribution", "parse_collectives",
+    "rank_skew", "validate_jsonl", "validate_record",
 ]

@@ -602,3 +602,66 @@ def format_attribution(report: Dict,
         lines.append(f"  {s['rank']:>4}  {s['name']:<29} {s['est_ms']:7.2f}"
                      f"  {s['share']*100:4.1f}%  {s['note']}")
     return "\n".join(lines)
+
+
+# -- cross-rank skew attribution (ISSUE 10) ------------------------------
+
+def rank_skew(records: List[Dict], tol: float = 0.20) -> Optional[Dict]:
+    """Rank cross-rank straggler suspects from per-process phase timings.
+
+    `records` are `rank_phase_stats` events (one per process per run:
+    obs/observer.py emits them at close from the goodput buckets, and the
+    proc-tagged metrics*.jsonl filenames keep them separable). The failure
+    mode this catches is the one ZeRO-3's per-layer gathers and the ring
+    overlap are most sensitive to: every collective runs at the pace of
+    the SLOWEST rank, so one rank stuck in `data_wait` (a slow host input
+    pipeline) or `h2d` (a sick PCIe link) taxes the whole mesh — and an
+    aggregate goodput number cannot say WHICH rank.
+
+    Returns None with < 2 records (nothing to compare). Otherwise:
+      * per-phase mean/max across ranks and `skew` = max/mean - 1,
+      * `suspects`: (process, phase) pairs whose time exceeds the phase
+        mean by more than `tol`, ranked by absolute excess seconds (the
+        wall-clock the mesh pays for that rank), and
+      * `persistent`: processes that are the worst rank in >= 2 phases
+        with skew past `tol` — a rank slow across phases is a sick HOST,
+        not a noisy measurement.
+    """
+    by_proc = {}
+    for r in records:
+        by_proc[int(r["process"])] = {k: float(v)
+                                      for k, v in r["phases_s"].items()}
+    if len(by_proc) < 2:
+        # DISTINCT ranks, not records: two single-process runs in one
+        # dir (a re-run staged script) must not render a fake one-rank
+        # "cross-rank" table with every skew at 0%
+        return None
+    phases = sorted({p for ph in by_proc.values() for p in ph})
+    out_phases, suspects, worst_count = {}, [], {}
+    for phase in phases:
+        vals = {proc: ph.get(phase, 0.0) for proc, ph in by_proc.items()}
+        mean = sum(vals.values()) / len(vals)
+        max_proc = max(vals, key=lambda p: vals[p])
+        mx = vals[max_proc]
+        skew = (mx / mean - 1.0) if mean > 0 else 0.0
+        out_phases[phase] = {"mean_s": round(mean, 6),
+                             "max_s": round(mx, 6),
+                             "max_process": max_proc,
+                             "skew": round(skew, 4)}
+        if mean <= 0:
+            continue
+        if skew > tol:
+            worst_count[max_proc] = worst_count.get(max_proc, 0) + 1
+        for proc, v in vals.items():
+            if v > mean * (1.0 + tol):
+                suspects.append({"process": proc, "phase": phase,
+                                 "excess_s": round(v - mean, 6),
+                                 "ratio": round(v / mean, 4)})
+    suspects.sort(key=lambda s: -s["excess_s"])
+    return {
+        "ranks": len(by_proc),
+        "tol": tol,
+        "phases": out_phases,
+        "suspects": suspects,
+        "persistent": sorted(p for p, c in worst_count.items() if c >= 2),
+    }

@@ -1,0 +1,113 @@
+"""Anomaly flight recorder: a bounded ring of recent telemetry that every
+anomaly path dumps as one self-contained JSON file (ISSUE 10).
+
+The r4/r5 outages were post-mortemed from TensorBoard scrollback and
+half-overwritten logs: the sentinel wrote its loss history, the watchdog
+printed its last phase, the serving engine counted preemptions — three
+disjoint partial contexts, none of which showed what the SYSTEM looked
+like in the seconds before the event. The flight recorder fixes the shape
+of the problem: producers `record()` cheap dict events (spans, heartbeats,
+pool stats, scheduler decisions) into a lock-protected `deque(maxlen=N)` —
+O(1) memory forever — and any anomaly path calls `dump(trigger)` to freeze
+the ring plus the triggering event into `flightdump_<tag>_<seq>.json`.
+
+One recorder is shared by every producer in a process (the train loop's
+observer, or a serving engine + its scheduler + KV pool), so a dump is the
+interleaved recent history of all of them, in arrival order. `max_dumps`
+caps the files a preemption storm can write; the skipped count is
+reported so a capped storm is still visible in the last dump's metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from .schema import EVENT_SCHEMA_VERSION
+
+
+class FlightRecorder:
+    def __init__(self, dump_dir: str, maxlen: int = 512, max_dumps: int = 8,
+                 clock: Callable[[], float] = time.monotonic):
+        if maxlen < 1:
+            raise ValueError(f"flight ring maxlen must be >= 1, got {maxlen}")
+        self.dump_dir = dump_dir
+        self.maxlen = maxlen
+        self.max_dumps = max_dumps
+        self._clock = clock
+        self._ring: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.recorded = 0          # total record() calls (ring may be full)
+        self.dumps: List[str] = []  # paths actually WRITTEN, trigger order
+        self.dumps_skipped = 0     # triggers past the max_dumps cap
+        self.dump_failures = 0     # writes that failed (disk full, ...)
+        self._dump_seq = 0         # filename sequence (failed writes too)
+        self._dumps_inflight = 0   # reserved slots with writes pending
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event to the ring. Cheap enough for per-decode-step
+        pool stats and per-page scheduler decisions — the deque evicts the
+        oldest entry at capacity, so memory is bounded whatever the rate."""
+        ev = {"ts": round(self._clock(), 6), "kind": kind, **fields}
+        with self._lock:
+            self._ring.append(ev)
+            self.recorded += 1
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def dump(self, trigger: dict, tag: str = "anomaly") -> Optional[str]:
+        """Freeze the ring + `trigger` into a self-contained JSON file and
+        return its path. Returns None once `max_dumps` files exist (a
+        preemption storm must not fill the disk); the cap-skip is counted
+        and stamped into every written dump's metadata. A FAILED write
+        (disk full, dump dir removed) also returns None — a diagnostic
+        artifact must never kill the run it is diagnosing — and does NOT
+        occupy a max_dumps slot or appear in `dumps`."""
+        with self._lock:
+            if len(self.dumps) + self._dumps_inflight >= self.max_dumps:
+                self.dumps_skipped += 1
+                return None
+            ring = list(self._ring)
+            # reserve a cap slot + a distinct FILENAME under the lock
+            # (concurrent triggers: watchdog thread + main loop); the
+            # dumps list only gains the path once the bytes are on disk
+            self._dumps_inflight += 1
+            seq = self._dump_seq
+            self._dump_seq += 1
+            path = os.path.join(
+                self.dump_dir, f"flightdump_{tag}_{seq:03d}.json")
+        doc = {
+            "schema_version": EVENT_SCHEMA_VERSION,
+            "tag": tag,
+            "trigger": {"ts": round(self._clock(), 6), **trigger},
+            "ring": ring,
+            "ring_maxlen": self.maxlen,
+            "recorded_total": self.recorded,
+            "dumps_skipped": self.dumps_skipped,
+            "wall_time": time.time(),
+        }
+        tmp = path + ".tmp"
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            with self._lock:
+                self._dumps_inflight -= 1
+                self.dump_failures += 1
+            return None
+        with self._lock:
+            self._dumps_inflight -= 1
+            self.dumps.append(path)
+        return path

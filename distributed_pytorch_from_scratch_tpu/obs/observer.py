@@ -18,6 +18,7 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
+from .flight import FlightRecorder
 from .goodput import GoodputMeter
 from .sentinel import HealthSentinel
 from .trace import SpanTracer
@@ -28,18 +29,27 @@ class TrainObserver:
     def __init__(self, log_dir: str, writer=None, trace: bool = True,
                  watchdog_secs: float = 0.0, sentinel: bool = True,
                  spike_factor: float = 3.0, halt_on_nonfinite: bool = True,
-                 process_index: int = 0):
+                 process_index: int = 0, flight_ring: int = 256):
         self.writer = writer
+        self.process_index = process_index
         self.tracer = SpanTracer(log_dir, enabled=trace, pid=process_index,
                                  process_name=f"train-p{process_index}")
         self.goodput = GoodputMeter()
+        # the anomaly flight recorder: every span/heartbeat lands in the
+        # ring, and the sentinel/watchdog flush it on their halt/stall
+        # paths so a post-mortem has the preceding seconds, not just the
+        # triggering event (flight_ring 0 disables)
+        self.flight = (FlightRecorder(log_dir, maxlen=flight_ring)
+                       if flight_ring > 0 else None)
         self.sentinel = (HealthSentinel(
             log_dir, spike_factor=spike_factor,
             halt_on_nonfinite=halt_on_nonfinite,
-            writer=writer, tracer=self.tracer) if sentinel else None)
+            writer=writer, tracer=self.tracer,
+            flight=self.flight) if sentinel else None)
         self.watchdog = (HangWatchdog(
             watchdog_secs, process_index=process_index, writer=writer,
-            tracer=self.tracer) if watchdog_secs > 0 else None)
+            tracer=self.tracer,
+            flight=self.flight) if watchdog_secs > 0 else None)
         self._closed = False
         self._local = threading.local()
 
@@ -62,7 +72,12 @@ class TrainObserver:
         finally:
             self._local.depth = depth
             if depth == 0:
-                self.goodput.account(bucket, time.perf_counter() - t0)
+                dur = time.perf_counter() - t0
+                self.goodput.account(bucket, dur)
+                if self.flight is not None:
+                    self.flight.record("span", bucket=bucket,
+                                       name=name or bucket,
+                                       dur_s=round(dur, 6), **args)
             if self.watchdog is not None:
                 # beat on exit too: after a long compile/checkpoint the
                 # stall clock restarts from completion, and the watchdog's
@@ -75,6 +90,8 @@ class TrainObserver:
     def heartbeat(self, step: int, tokens: int = 0, steps: int = 1) -> None:
         """Called once per completed dispatch: liveness + progress."""
         self.goodput.add_progress(tokens, steps)
+        if self.flight is not None:
+            self.flight.record("heartbeat", step=step, tokens=tokens)
         if self.watchdog is not None:
             self.watchdog.beat(step=step)
 
@@ -117,6 +134,14 @@ class TrainObserver:
         summary = self.goodput.summary()
         if self.writer is not None:
             self.writer.event("goodput_summary", **summary)
+            # proc-tagged per-rank phase timings: the cross-rank skew
+            # attribution's input (obs/attribution.rank_skew) — each
+            # process writes its own metrics*.jsonl, so the collection
+            # across files IS the per-rank view
+            self.writer.event(
+                "rank_phase_stats", process=self.process_index,
+                phases_s=summary["buckets_s"], steps=summary["steps"],
+                tokens=summary["tokens"], wall_s=summary["wall_s"])
         if print_summary:
             print(GoodputMeter.format_summary(summary))
         path = self.tracer.close()

@@ -1,0 +1,195 @@
+"""Per-request span timelines for the serving stack (ISSUE 10).
+
+`loadgen` has always reported TTFT/TPOT percentiles — COUNTS of SLO
+misses. This module makes each miss EXPLAINABLE: every request carries a
+trace id from submit to retire, and the engines mark phase transitions
+(`queued`, `prefill_chunk`, `decode`, `spec_round`, `preempted`, ...) on
+its timeline as they happen. The timeline is CONTIGUOUS by construction —
+each mark closes the span that started at the previous mark — so the span
+sum always equals the request's wall time (submit -> finish = TTFT +
+decode wall), and a gap can never hide: whatever the engine was doing from
+this request's point of view has a named span.
+
+Memory stays bounded two ways: adjacent same-phase marks COALESCE (a
+64-token decode is one span with count=64, its numeric args summed — the
+waterfall needs phase totals, not per-token rows), and the retired-record
+store is a ring (`max_completed`).
+
+On retire the timeline is emitted three ways:
+* a `request_trace` MetricsWriter event (jsonl — the machine-readable
+  record `summarize_run.py`'s waterfall and the k-worst exemplars read),
+* Chrome-trace spans on a synthetic per-request track in the existing
+  `SpanTracer` file, with a flow arrow binding enqueue to retire, so a
+  request's life renders alongside the engine's dispatch spans,
+* `completed[rid]` for in-process consumers (loadgen's k-worst picker).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+# synthetic Chrome-trace track ids for request timelines: far above any
+# real thread id is impossible (they are huge), so instead requests map
+# onto a small band of dedicated tracks by rid
+REQ_TRACK_BASE = 1_000_000
+REQ_TRACKS = 64
+
+
+@dataclass
+class _Timeline:
+    rid: int
+    trace_id: str
+    t0: float
+    last: float
+    spans: List[dict] = field(default_factory=list)
+    counters: Dict[str, float] = field(default_factory=dict)
+
+
+class RequestTracer:
+    """Thread-unsafe by design: all marks come from the engine's host
+    loop (one thread). `clock` must be the ENGINE's clock (the Request
+    timestamps' clock), so span sums agree with `ttft_s`/`tpot_s`."""
+
+    def __init__(self, writer=None, tracer=None, flight=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_completed: int = 8192):
+        self.writer = writer
+        self.tracer = tracer
+        self.flight = flight
+        self._clock = clock
+        # engine-clock -> tracer-clock translation, sampled once so the
+        # request tracks land at the right offsets among the host spans
+        self._off = (tracer.now() - clock()) if tracer is not None else 0.0
+        self._live: Dict[int, _Timeline] = {}
+        self.completed: "OrderedDict[int, dict]" = OrderedDict()
+        self.max_completed = max_completed
+        self._seq = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def begin(self, req, t: Optional[float] = None) -> str:
+        """Open a timeline at submit time (use the request's `submit_t` —
+        loadgen backdates it to the planned arrival, and TTFT is measured
+        from there). Assigns `req.trace_id`. Re-begin of a live rid is a
+        no-op returning the existing id (a preempted request re-enters
+        through `requeue`, never through a second submit)."""
+        tl = self._live.get(req.rid)
+        if tl is not None:
+            return tl.trace_id
+        self._seq += 1
+        trace_id = f"r{req.rid}.{self._seq}"
+        req.trace_id = trace_id
+        t = req.submit_t if t is None else t
+        if t is None:
+            t = self._clock()
+        self._live[req.rid] = _Timeline(rid=req.rid, trace_id=trace_id,
+                                        t0=t, last=t)
+        return trace_id
+
+    def mark(self, req, phase: str, t: Optional[float] = None,
+             **num_args) -> None:
+        """Close the span running since the last mark and label it
+        `phase`. Numeric kwargs accumulate across coalesced marks
+        (`positions`, `cow`, `accepted`, ...)."""
+        tl = self._live.get(req.rid)
+        if tl is None:
+            return
+        t = self._clock() if t is None else t
+        if t < tl.last:          # monotonic clocks only; clamp regardless
+            t = tl.last
+        last = tl.spans[-1] if tl.spans else None
+        if last is not None and last["name"] == phase:
+            last["end"] = t
+            last["count"] += 1
+            for k, v in num_args.items():
+                last[k] = last.get(k, 0) + v
+        else:
+            tl.spans.append({"name": phase, "start": tl.last, "end": t,
+                             "count": 1, **num_args})
+        tl.last = t
+
+    def note(self, req, **counters) -> None:
+        """Accumulate request-scoped counters (page leases/frees, COW
+        copies) reported once in the retire record, not per span."""
+        tl = self._live.get(req.rid)
+        if tl is None:
+            return
+        for k, v in counters.items():
+            tl.counters[k] = tl.counters.get(k, 0) + v
+
+    def retire(self, req, t: Optional[float] = None) -> Optional[dict]:
+        """Finalize + emit. Residual time between the last mark and the
+        finish stamp becomes a closing `retire` span, so the span sum
+        equals finish - submit EXACTLY."""
+        tl = self._live.pop(req.rid, None)
+        if tl is None:
+            return None
+        t = (req.finish_t if req.finish_t is not None else self._clock()) \
+            if t is None else t
+        if t > tl.last + 1e-9:
+            tl.spans.append({"name": "retire", "start": tl.last, "end": t,
+                             "count": 1})
+            tl.last = t
+        ms = lambda s: round(s * 1e3, 3)
+        spans = [{"name": s["name"],
+                  "start_ms": ms(s["start"] - tl.t0),
+                  "dur_ms": ms(s["end"] - s["start"]),
+                  **{k: v for k, v in s.items()
+                     if k not in ("name", "start", "end")}}
+                 for s in tl.spans]
+        rec = {
+            "rid": req.rid,
+            "trace_id": tl.trace_id,
+            "spans": spans,
+            "total_ms": ms(tl.last - tl.t0),
+            "ttft_ms": None if req.ttft_s is None else ms(req.ttft_s),
+            "tpot_ms": None if req.tpot_s is None else ms(req.tpot_s),
+            "prompt_len": req.prompt_len or len(req.prompt),
+            "generated": len(req.tokens),
+            "preemptions": req.preemptions,
+            "tenant": req.tenant,
+            "slo_class": req.slo_class,
+            **tl.counters,
+        }
+        if self.writer is not None:
+            self.writer.event("request_trace", **rec)
+        if self.tracer is not None:
+            tid = REQ_TRACK_BASE + (req.rid % REQ_TRACKS)
+            off = self._off
+            for s in tl.spans:
+                args = {k: v for k, v in s.items()
+                        if k not in ("name", "start", "end")}
+                self.tracer.complete_span(
+                    f"req{req.rid}:{s['name']}", s["start"] + off,
+                    s["end"] + off, cat="request", tid=tid,
+                    trace_id=tl.trace_id, **args)
+            # flow arrow: submit -> retire, id'd by the tracer sequence so
+            # rid reuse across runs cannot cross-link
+            self.tracer.flow(f"req{req.rid}", "s", self._seq_of(tl),
+                             tl.t0 + off, tid=tid)
+            self.tracer.flow(f"req{req.rid}", "f", self._seq_of(tl),
+                             tl.last + off, tid=tid)
+        if self.flight is not None:
+            self.flight.record("request_retired", rid=req.rid,
+                               total_ms=rec["total_ms"],
+                               ttft_ms=rec["ttft_ms"],
+                               preemptions=req.preemptions)
+        self.completed[req.rid] = rec
+        while len(self.completed) > self.max_completed:
+            self.completed.popitem(last=False)
+        return rec
+
+    @staticmethod
+    def _seq_of(tl: _Timeline) -> int:
+        return int(tl.trace_id.rsplit(".", 1)[1])
+
+    # -- accessors --------------------------------------------------------
+    @property
+    def live(self) -> int:
+        return len(self._live)
+
+    def timeline(self, rid: int) -> Optional[dict]:
+        """The retired record for `rid` (None while live / evicted)."""
+        return self.completed.get(rid)

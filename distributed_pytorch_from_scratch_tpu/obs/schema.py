@@ -1,0 +1,100 @@
+"""MetricsWriter event-schema versioning + jsonl validation (ISSUE 10).
+
+Every structured `MetricsWriter.event(...)` record now carries a
+`schema_version` field, and this module is the one place that says what a
+consumer may rely on: `EVENT_REQUIRED` maps each event tag to the fields
+`scripts/summarize_run.py` and `scripts/check_bench_regression.py` key on.
+Consumers call `validate_jsonl` BEFORE rendering, so a drifted producer
+(a renamed field, a tag emitted without its contract) fails LOUDLY in the
+summary instead of silently dropping a section — the exact rot mode the
+r4/r5 post-mortems hit with regexes over free-form logs.
+
+Deliberately dependency-free (no jax, no package imports): the validators
+must be importable from standalone scripts and from `training/metrics.py`
+without creating an import cycle.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+# Bump when an event's field contract changes incompatibly. Version 2 =
+# the ISSUE-10 schema: versioned events + the request-trace/flight/skew
+# event family. (Version 1 is retroactively "any pre-versioned event".)
+EVENT_SCHEMA_VERSION = 2
+
+# tag -> fields a consumer may key on (presence contract, not types).
+# Only EVENT tags appear here — scalar ({"tag", "value", "step"}) and text
+# records are TensorBoard-shaped and stay unversioned.
+EVENT_REQUIRED: Dict[str, Tuple[str, ...]] = {
+    "goodput_summary": ("wall_s", "buckets_s", "goodput", "steps"),
+    "cost_analysis": ("flops",),
+    "serving_summary": ("requests", "completed", "tokens_per_sec"),
+    "paged_kv_stats": ("page_size", "num_pages", "kv_util_mean"),
+    "spec_decode_stats": ("speculate_k", "spec_rounds"),
+    "serve_request": ("rid", "generated"),
+    # -- ISSUE 10: the request-scoped / rank-scoped family ---------------
+    "request_trace": ("rid", "trace_id", "spans", "total_ms"),
+    "request_exemplars": ("k", "worst_ttft", "worst_tpot"),
+    "rank_phase_stats": ("process", "phases_s", "steps"),
+    "sentinel/nonfinite": ("reason",),
+    "watchdog/stall": ("process", "stalled_for"),
+}
+
+
+def is_event_record(rec: dict) -> bool:
+    """Structured event vs a scalar/text record: events have a tag but
+    neither a scalar `value` nor a `text` payload."""
+    return ("tag" in rec and "value" not in rec and "text" not in rec)
+
+
+def validate_record(rec: dict) -> List[str]:
+    """Problems with one parsed record (empty list = fine). Scalar/text
+    records always pass; unknown event tags only need a sane version."""
+    if not isinstance(rec, dict):
+        return ["record is not a JSON object"]
+    if "tag" not in rec:
+        return ["record has no 'tag'"]
+    if not is_event_record(rec):
+        return []
+    tag = rec["tag"]
+    problems = []
+    v = rec.get("schema_version")
+    if v is None:
+        problems.append(f"{tag}: missing schema_version (pre-v"
+                        f"{EVENT_SCHEMA_VERSION} writer? regenerate, or "
+                        f"treat fields as best-effort)")
+    elif not isinstance(v, int) or v < 1:
+        problems.append(f"{tag}: schema_version {v!r} is not a positive int")
+    elif v > EVENT_SCHEMA_VERSION:
+        problems.append(f"{tag}: schema_version {v} is NEWER than this "
+                        f"reader ({EVENT_SCHEMA_VERSION}) — update the "
+                        f"consumer before trusting its rendering")
+    for field in EVENT_REQUIRED.get(tag, ()):
+        if field not in rec:
+            problems.append(f"{tag}: missing required field {field!r}")
+    return problems
+
+
+def validate_jsonl(path: str, max_problems: int = 20) -> List[str]:
+    """Validate every line of a metrics*.jsonl file; returns problem
+    strings prefixed with the line number (capped at `max_problems` so a
+    wholly drifted file does not flood the summary)."""
+    problems: List[str] = []
+    with open(path, errors="replace") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                problems.append(f"line {lineno}: unparseable JSON")
+                continue
+            problems.extend(f"line {lineno}: {p}"
+                            for p in validate_record(rec))
+            if len(problems) >= max_problems:
+                problems.append(f"... (stopped at {max_problems} problems)")
+                return problems
+    return problems

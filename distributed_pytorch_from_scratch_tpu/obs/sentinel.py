@@ -37,13 +37,14 @@ class TrainingHealthError(RuntimeError):
 class HealthSentinel:
     def __init__(self, dump_dir: str, spike_factor: float = 3.0,
                  ema_decay: float = 0.9, halt_on_nonfinite: bool = True,
-                 history: int = 64, writer=None, tracer=None):
+                 history: int = 64, writer=None, tracer=None, flight=None):
         self.dump_dir = dump_dir
         self.spike_factor = spike_factor
         self.ema_decay = ema_decay
         self.halt_on_nonfinite = halt_on_nonfinite
         self.writer = writer
         self.tracer = tracer
+        self.flight = flight  # obs.flight.FlightRecorder — flushed on halt
         self.ema: Optional[float] = None
         self.spikes = 0
         self._history = deque(maxlen=history)
@@ -88,14 +89,24 @@ class HealthSentinel:
         `tprank-*` file full of NaNs would become `latest_step` and poison
         the next `--resume`. The post-mortem pair is this file (the WHY)
         plus the last regular checkpoint (healthy params from at most
-        save_interval steps earlier)."""
+        save_interval steps earlier). When a flight recorder is attached,
+        its ring is flushed FIRST and the two files cross-link — one
+        anomaly, one pair of artifacts, no disjoint partial context."""
         os.makedirs(self.dump_dir, exist_ok=True)
         path = os.path.join(self.dump_dir, f"sentinel_dump_step{step}.json")
+        flight_path = None
+        if self.flight is not None:
+            flight_path = self.flight.dump(
+                {"kind": "sentinel_nonfinite", "step": int(step),
+                 "reason": reason, "sentinel_dump": path},
+                tag="sentinel")
         with open(path, "w") as f:
             json.dump({"reason": reason, "step": int(step), "ema": self.ema,
                        "spikes": self.spikes, "ts": time.time(),
+                       "flight_dump": flight_path,
                        "history": list(self._history)}, f, indent=1)
-        print(f"sentinel: state dump written to {path}")
+        print(f"sentinel: state dump written to {path}"
+              + (f" (flight recorder: {flight_path})" if flight_path else ""))
         return path
 
     def _event(self, tag: str, step: int, **fields) -> None:

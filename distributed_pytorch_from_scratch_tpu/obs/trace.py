@@ -110,6 +110,39 @@ class SpanTracer:
             ev["args"] = args
         self._emit(ev)
 
+    def complete_span(self, name: str, start: float, end: float,
+                      cat: Optional[str] = None, tid: Optional[int] = None,
+                      **args) -> None:
+        """Complete event from two explicit clock samples (same clock as
+        `now()`). `tid` overrides the thread id — synthetic per-request
+        tracks (obs/reqtrace.py) use it so a request's whole timeline
+        renders as one row instead of scattering over host threads."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "ph": "X", "ts": self._ts_us(start),
+              "dur": max(end - start, 0.0) * 1e6, "pid": self.pid,
+              "tid": threading.get_ident() if tid is None else tid}
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def flow(self, name: str, phase: str, flow_id: int, t: float,
+             tid: Optional[int] = None) -> None:
+        """Flow event (`ph` in {"s","t","f"}): draws an arrow between
+        tracks in the viewer. The request tracer binds a request's
+        enqueue to its retire so a cross-track timeline is followable."""
+        if not self.enabled:
+            return
+        assert phase in ("s", "t", "f"), phase
+        ev = {"name": name, "ph": phase, "id": int(flow_id),
+              "cat": "request", "ts": self._ts_us(t), "pid": self.pid,
+              "tid": threading.get_ident() if tid is None else tid}
+        if phase == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice
+        self._emit(ev)
+
     def instant(self, name: str, **args) -> None:
         if not self.enabled:
             return

@@ -27,7 +27,7 @@ from typing import Callable, Optional
 
 class HangWatchdog:
     def __init__(self, timeout_s: float, process_index: int = 0,
-                 writer=None, tracer=None,
+                 writer=None, tracer=None, flight=None,
                  on_stall: Optional[Callable[[dict], None]] = None,
                  clock: Callable[[], float] = time.monotonic,
                  poll_s: Optional[float] = None):
@@ -35,6 +35,7 @@ class HangWatchdog:
         self.process_index = process_index
         self.writer = writer
         self.tracer = tracer
+        self.flight = flight  # obs.flight.FlightRecorder — flushed on stall
         self.on_stall = on_stall
         self._clock = clock
         self._lock = threading.Lock()
@@ -103,15 +104,27 @@ class HangWatchdog:
                 self._stalled = True
                 self.stall_count += 1
                 last_step, last_phase = self._last_step, self._last_phase
-            # I/O and the on_stall callback run lock-free (see beat())
-            self._emit("watchdog/stall", stalled_for=round(stalled_for, 3))
+            # I/O and the on_stall callback run lock-free (see beat());
+            # the flight ring freezes FIRST so the dump shows the system
+            # state that preceded the stall, cross-linked from the event
+            flight_path = None
+            if self.flight is not None:
+                flight_path = self.flight.dump(
+                    {"kind": "watchdog_stall",
+                     "process": self.process_index,
+                     "last_step": last_step, "last_phase": last_phase,
+                     "stalled_for": round(stalled_for, 3)},
+                    tag="watchdog")
+            self._emit("watchdog/stall", stalled_for=round(stalled_for, 3),
+                       flight_dump=flight_path)
             print(f"WATCHDOG[p{self.process_index}]: no progress for "
                   f"{stalled_for:.1f}s — last completed step "
                   f"{last_step}, last activity "
                   f"'{last_phase}' (may still be executing — a "
                   f"'recovered' line follows if it finishes). If every "
                   f"process reports the same step, suspect the input "
-                  f"pipeline; if they differ, a collective is hung.",
+                  f"pipeline; if they differ, a collective is hung."
+                  + (f" Flight dump: {flight_path}" if flight_path else ""),
                   flush=True)
 
     def close(self) -> None:

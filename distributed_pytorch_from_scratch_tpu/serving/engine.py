@@ -92,6 +92,7 @@ class Request:
     arrival: float = 0.0                 # loadgen's planned arrival offset
     tenant: str = "default"              # fair-queuing bucket (SLOScheduler)
     slo_class: Optional[str] = None      # TTFT deadline class (None=default)
+    trace_id: Optional[str] = None       # per-request trace (obs/reqtrace)
     tokens: List[int] = field(default_factory=list)
     submit_t: Optional[float] = None     # entered the admission queue
     admit_t: Optional[float] = None      # left the queue (prefill dispatch)
@@ -184,7 +185,8 @@ class ContinuousBatchingEngine:
                  prefill_bucket: int = 64, max_prefill_batch: int = 4,
                  max_queue: int = 0, debug_host_sampler: bool = False,
                  decode_weight_dtype=None,
-                 tracer=None, writer=None, clock=time.monotonic):
+                 tracer=None, writer=None, request_tracer=None,
+                 flight=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -209,6 +211,8 @@ class ContinuousBatchingEngine:
         self._clock = clock
         self.tracer = tracer
         self.writer = writer
+        self.rt = request_tracer        # obs.reqtrace.RequestTracer | None
+        self.flight = flight            # obs.flight.FlightRecorder | None
         self._dtype = resolve_dtype(model.cfg.compute_dtype)
         self._table_len = max(model.cfg.maxlen, buf_len)
         # sampling knobs kept on the engine: the fused in-program sampler
@@ -222,7 +226,8 @@ class ContinuousBatchingEngine:
         _setup_decode_weights(self, model, mesh, params, decode_weight_dtype)
         self.pool = KVCachePool(model, mesh, num_slots, buf_len)
         self.scheduler = FIFOScheduler(buf_len, prefill_bucket=prefill_bucket,
-                                       max_queue=max_queue, clock=clock)
+                                       max_queue=max_queue, clock=clock,
+                                       flight=flight)
         n = num_slots + 1  # + the scratch row (kv_manager.py)
         self._tokens = np.zeros(n, np.int32)
         self._pos = np.zeros(n, np.int32)
@@ -300,8 +305,11 @@ class ContinuousBatchingEngine:
     # -- request intake --------------------------------------------------
     def submit(self, req: Request) -> None:
         """FIFO enqueue (raises scheduler.QueueFull past the backpressure
-        bound)."""
+        bound). An accepted request opens its trace timeline at submit_t
+        (rejected ones never get one — they have no life to explain)."""
         self.scheduler.submit(req)
+        if self.rt is not None:
+            self.rt.begin(req)
 
     def has_work(self) -> bool:
         return bool(self.scheduler.pending or self._slot_req)
@@ -349,6 +357,8 @@ class ContinuousBatchingEngine:
                 req.prompt_len = len(req.prompt)
                 req.limit = min(req.prompt_len + req.max_new, self.buf_len)
                 self.prompt_tokens += req.prompt_len
+                if self.rt is not None:
+                    self.rt.mark(req, "queued", now)
                 if req.limit <= req.prompt_len:   # max_new == 0
                     req.finish_t = now
                     self._complete(req, done)
@@ -386,6 +396,8 @@ class ContinuousBatchingEngine:
         now = self._clock()
         for i, req in enumerate(ready):
             req.first_token_t = now
+            if self.rt is not None:
+                self.rt.mark(req, "prefill", now, positions=req.prompt_len)
             first = int(tok[i])
             if first == self.eos_id:              # 0 generated tokens
                 req.finish_t = now
@@ -418,9 +430,15 @@ class ContinuousBatchingEngine:
         self._occupancy_sum += self.pool.occupancy
         if self.tracer is not None:
             self.tracer.counter("slots_live", len(self._slot_req))
+        if self.flight is not None:
+            self.flight.record("pool_stats", live=len(self._slot_req),
+                               free_slots=self.pool.free_slots,
+                               queued=self.scheduler.pending)
         for slot, req in list(self._slot_req.items()):
             # the pending token was written at `pos` by this dispatch: it
             # is now part of the output (mirrors make_generate's buf write)
+            if self.rt is not None:
+                self.rt.mark(req, "decode", now)
             req.tokens.append(int(self._tokens[slot]))
             self.generated_tokens += 1
             cand = int(tok[slot])
@@ -437,11 +455,13 @@ class ContinuousBatchingEngine:
     def _complete(self, req: Request, done: List[Request]) -> None:
         self.completed.append(req)
         done.append(req)
+        if self.rt is not None:
+            self.rt.retire(req)
         if self.writer is not None:
             ms = lambda s: None if s is None else round(s * 1e3, 3)
             self.writer.event(
                 "serve_request", rid=req.rid, prompt_len=req.prompt_len,
-                generated=len(req.tokens),
+                generated=len(req.tokens), trace_id=req.trace_id,
                 queue_wait_ms=ms(req.queue_wait_s), ttft_ms=ms(req.ttft_s),
                 tpot_ms=ms(req.tpot_s))
 
@@ -529,7 +549,8 @@ class PagedEngine:
                  slo_classes=None, default_class: str = "standard",
                  max_queue: int = 0, debug_host_sampler: bool = False,
                  kv_dtype=None, decode_weight_dtype=None,
-                 tracer=None, writer=None, clock=time.monotonic):
+                 tracer=None, writer=None, request_tracer=None,
+                 flight=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -565,6 +586,8 @@ class PagedEngine:
         self._clock = clock
         self.tracer = tracer
         self.writer = writer
+        self.rt = request_tracer        # obs.reqtrace.RequestTracer | None
+        self.flight = flight            # obs.flight.FlightRecorder | None
         self._dtype = resolve_dtype(model.cfg.compute_dtype)
         self._table_len = max(model.cfg.maxlen, self.buf_len)
         # fused in-program sampling is the only production path; the knobs
@@ -579,10 +602,11 @@ class PagedEngine:
         # lease/COW/free accounting (kv_manager.PagedKVPool docstring)
         self.kv_dtype = kv_dtype
         self.pool = PagedKVPool(model, mesh, num_pages, page_size,
-                                kv_dtype=kv_dtype)
+                                kv_dtype=kv_dtype, flight=flight)
         self.scheduler = SLOScheduler(self.buf_len, classes=slo_classes,
                                       default_class=default_class,
-                                      max_queue=max_queue, clock=clock)
+                                      max_queue=max_queue, clock=clock,
+                                      flight=flight)
         self._free_slots = deque(range(num_slots))
         # (slots, max_pages) page table; free rows aim at the scratch page
         self._tbl = np.full((num_slots, self.max_pages),
@@ -677,6 +701,8 @@ class PagedEngine:
                 f"{self.page_size}) but the pool has {self.pool.num_pages} "
                 f"— raise --num_pages or lower the budget")
         self.scheduler.submit(req)
+        if self.rt is not None:
+            self.rt.begin(req)
 
     def has_work(self) -> bool:
         return bool(self.scheduler.pending or self._slot_req
@@ -755,6 +781,8 @@ class PagedEngine:
             self._tbl[slot, j] = best_page
             st.s += best_len
             self.prefix_hit_tokens += best_len
+            if self.rt is not None:
+                self.rt.note(st.req, prefix_hit_tokens=best_len)
             if best_len < ps:
                 break                      # partial match ends the walk
 
@@ -785,6 +813,10 @@ class PagedEngine:
                 req.prompt_len = len(req.prompt)
                 req.limit = min(req.prompt_len + req.max_new, self.buf_len)
                 self.prompt_tokens += req.prompt_len
+            if self.rt is not None:
+                # covers the first admission AND every preempt-resume
+                # re-admission (the span since `preempted` was queue time)
+                self.rt.mark(req, "queued", now)
             if req.limit <= len(ids):      # max_new == 0
                 req.finish_t = now
                 self._complete(req, done)
@@ -834,24 +866,39 @@ class PagedEngine:
             req = self._slot_req.pop(slot)
         else:
             req = self._prefilling.pop(slot).req
-        self._release_slot(slot)
+        freed = self._release_slot(slot)
         req.preemptions += 1
         self.preemptions += 1
+        if self.rt is not None:
+            self.rt.mark(req, "preempted", self._clock())
+            self.rt.note(req, pages_freed=freed)
+        if self.flight is not None:
+            self.flight.record("preempt", rid=req.rid, slot=slot,
+                               generated=len(req.tokens),
+                               pages_freed=freed,
+                               slo_class=req.slo_class)
         self.scheduler.requeue(req)
 
-    def _release_slot(self, slot: int) -> None:
+    def _release_slot(self, slot: int) -> int:
+        """Returns the number of page references dropped (the request-
+        trace pages_freed counter)."""
         scratch = self.pool.scratch_page
+        freed = 0
         for j in range(self.max_pages):
             if self._tbl[slot, j] != scratch:
                 self.pool.unref(int(self._tbl[slot, j]))
                 self._tbl[slot, j] = scratch
+                freed += 1
         self._pos[slot] = 0
         self._free_slots.append(slot)
+        return freed
 
     def _alloc_page(self, needy_slot: int) -> int:
         """A free page, evicting victims if the pool is dry (never the
         needy slot itself). Submit-time validation guarantees a sole live
-        request fits, so exhaustion with no victim cannot happen."""
+        request fits, so exhaustion with no victim cannot happen. A
+        PoolExhausted-forced preemption freezes the flight ring: the dump
+        shows the pool/scheduler state that led to the eviction."""
         while True:
             try:
                 return self.pool.alloc()
@@ -862,24 +909,39 @@ class PagedEngine:
                         "page pool exhausted with no preemption candidate "
                         "— a single request outgrew num_pages (submit-time "
                         "validation should have refused it)")
-                self._preempt(cands[0][0])
+                victim_slot, victim = cands[0]
+                self._preempt(victim_slot)
+                if self.flight is not None:
+                    self.flight.dump(
+                        {"kind": "pool_exhausted_preempt",
+                         "needy_slot": needy_slot,
+                         "victim_rid": victim.rid,
+                         "victim_slot": victim_slot,
+                         "victim_generated": len(victim.tokens),
+                         "num_pages": self.pool.num_pages},
+                        tag="pool_exhausted")
 
-    def _ensure_writable(self, slot: int, lo: int, hi: int) -> None:
+    def _ensure_writable(self, slot: int, lo: int, hi: int):
         """Positions [lo, hi) of `slot` must land in PRIVATE pages before
         a write dispatch: unmapped entries allocate, shared entries
-        copy-on-write (one bucketed copy dispatch)."""
+        copy-on-write (one bucketed copy dispatch). Returns
+        (pages_allocated, cow_copies) so callers can attribute the page
+        churn to the owning request's timeline."""
         ps, scratch = self.page_size, self.pool.scratch_page
         pairs = []
+        allocated = 0
         for j in range(lo // ps, -(-hi // ps)):
             pid = int(self._tbl[slot, j])
             if pid == scratch:
                 self._tbl[slot, j] = self._alloc_page(slot)
+                allocated += 1
             elif self.pool.refcount[pid] > 1:
                 new = self._alloc_page(slot)
                 pairs.append((pid, new))
                 self.pool.unref(pid)
                 self._tbl[slot, j] = new
         self.pool.copy_pages(pairs)
+        return allocated, len(pairs)
 
     def _pump_prefill(self, done: List[Request]) -> None:
         """Advance prefills chunk by chunk. While ANY stream is live
@@ -907,7 +969,7 @@ class PagedEngine:
                         done: List[Request]) -> None:
         ps = self.page_size
         s, ids, req = st.s, st.ids, st.req
-        self._ensure_writable(slot, s, s + n)
+        leased, cowed = self._ensure_writable(slot, s, s + n)
         cw = _pow2_at_most(n, self.prefill_chunk)
         buf, dstp, dsto = _chunk_maps(ids, s, n, cw, ps, self.eos_id,
                                       self.pool.scratch_page,
@@ -935,6 +997,10 @@ class PagedEngine:
                 self.pool.register_prefix(parent, int(self._tbl[slot, j]),
                                           ids[j * ps:end])
         st.s += n
+        if self.rt is not None:
+            self.rt.mark(req, "prefill_chunk", self._clock(),
+                         positions=n, cow=cowed)
+            self.rt.note(req, pages_leased=leased, cow_copies=cowed)
         if st.s >= len(ids):
             self._finish_prefill(slot, st, int(tok[0]), done)
 
@@ -947,7 +1013,9 @@ class PagedEngine:
             req.first_token_t = now
         if first == self.eos_id:              # 0 (more) generated tokens
             req.finish_t = now
-            self._release_slot(slot)
+            freed = self._release_slot(slot)
+            if self.rt is not None:
+                self.rt.note(req, pages_freed=freed)
             self._complete(req, done)
             return
         self._slot_req[slot] = req
@@ -963,7 +1031,11 @@ class PagedEngine:
             if slot not in self._slot_req:
                 continue
             pos = int(self._pos[slot])
-            self._ensure_writable(slot, pos, pos + 1)
+            leased, cowed = self._ensure_writable(slot, pos, pos + 1)
+            if self.rt is not None and (leased or cowed):
+                req = self._slot_req.get(slot)
+                if req is not None:
+                    self.rt.note(req, pages_leased=leased, cow_copies=cowed)
         if not self._slot_req:
             return
         # the dispatch is dense over ALL slot rows, and a non-live row
@@ -1002,7 +1074,15 @@ class PagedEngine:
         if self.tracer is not None:
             self.tracer.counter("slots_live", len(self._slot_req))
             self.tracer.counter("pages_in_use", used)
+        if self.flight is not None:
+            self.flight.record("pool_stats", live=len(self._slot_req),
+                               prefilling=len(self._prefilling),
+                               pages_in_use=used,
+                               free_pages=self.pool.free_pages,
+                               queued=self.scheduler.pending)
         for slot, req in list(self._slot_req.items()):
+            if self.rt is not None:
+                self.rt.mark(req, "decode", now)
             req.tokens.append(int(self._tokens[slot]))
             self.generated_tokens += 1
             cand = int(tok[slot])
@@ -1010,7 +1090,9 @@ class PagedEngine:
             if cand == self.eos_id or req.prompt_len + len(req.tokens) >= req.limit:
                 req.finish_t = now
                 del self._slot_req[slot]
-                self._release_slot(slot)
+                freed = self._release_slot(slot)
+                if self.rt is not None:
+                    self.rt.note(req, pages_freed=freed)
                 self._complete(req, done)
             else:
                 self._tokens[slot] = cand
@@ -1018,12 +1100,15 @@ class PagedEngine:
     def _complete(self, req: Request, done: List[Request]) -> None:
         self.completed.append(req)
         done.append(req)
+        if self.rt is not None:
+            self.rt.retire(req)
         if self.writer is not None:
             ms = lambda s: None if s is None else round(s * 1e3, 3)
             self.writer.event(
                 "serve_request", rid=req.rid, prompt_len=req.prompt_len,
                 generated=len(req.tokens), tenant=req.tenant,
                 slo_class=req.slo_class, preemptions=req.preemptions,
+                trace_id=req.trace_id,
                 queue_wait_ms=ms(req.queue_wait_s), ttft_ms=ms(req.ttft_s),
                 tpot_ms=ms(req.tpot_s))
 

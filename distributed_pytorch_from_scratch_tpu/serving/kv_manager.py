@@ -190,7 +190,7 @@ class PagedKVPool:
     the capacity win `bench.py --serving --kv_dtype int8` measures."""
 
     def __init__(self, model, mesh: Mesh, num_pages: int, page_size: int,
-                 kv_dtype=None):
+                 kv_dtype=None, flight=None):
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
@@ -202,6 +202,7 @@ class PagedKVPool:
         self.num_pages = num_pages
         self.page_size = page_size
         self.scratch_page = num_pages          # never leased; pad target
+        self.flight = flight  # obs.flight.FlightRecorder: pool anomalies
         self.kv_dtype = "int8" if kv_dtype in ("int8", jnp.int8) else None
         shape = (cfg.num_layers, num_pages + 1, cfg.kv_heads, page_size,
                  cfg.head_dim)
@@ -241,6 +242,9 @@ class PagedKVPool:
 
     def alloc(self) -> int:
         if not self._free:
+            if self.flight is not None:
+                self.flight.record("pool_exhausted",
+                                   num_pages=self.num_pages)
             raise PoolExhausted(
                 f"page pool exhausted ({self.num_pages} pages leased) — "
                 f"the engine preempts or the scheduler gates admission")
@@ -336,6 +340,9 @@ class PagedKVPool:
                                    jnp.asarray(dst))
         self.adopt(ks, vs)
         self.cow_copies += len(pairs)
+        if self.flight is not None:
+            self.flight.record("cow_copy", pages=len(pairs),
+                               free_pages=len(self._free))
 
     # -- device-array handoff ---------------------------------------------
     def adopt(self, ks, vs) -> None:

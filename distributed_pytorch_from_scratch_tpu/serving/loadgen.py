@@ -116,8 +116,41 @@ def _pctl(vals: List[Optional[float]], q: float) -> Optional[float]:
     return float(np.percentile(np.asarray(vals, np.float64), q))
 
 
+def worst_request_exemplars(engine, done, k: int = 3) -> Optional[dict]:
+    """The k-worst TTFT and TPOT requests WITH their span timelines (from
+    the engine's request tracer) — the difference between counting SLO
+    misses and explaining them. None when tracing is off."""
+    rt = getattr(engine, "rt", None)
+    if rt is None:
+        return None
+    ms = 1e3
+
+    def exemplars(key):
+        ranked = sorted((r for r in done if key(r) is not None),
+                        key=key, reverse=True)[:k]
+        out = []
+        for r in ranked:
+            rec = rt.timeline(r.rid)
+            out.append({
+                "rid": r.rid, "trace_id": r.trace_id,
+                "ttft_ms": None if r.ttft_s is None
+                else round(r.ttft_s * ms, 3),
+                "tpot_ms": None if r.tpot_s is None
+                else round(r.tpot_s * ms, 3),
+                "preemptions": r.preemptions,
+                "slo_class": r.slo_class,
+                "timeline": rec["spans"] if rec else None,
+            })
+        return out
+
+    return {"k": k,
+            "worst_ttft": exemplars(lambda r: r.ttft_s),
+            "worst_tpot": exemplars(lambda r: r.tpot_s)}
+
+
 def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
-                clock=time.monotonic, sleep=time.sleep) -> dict:
+                clock=time.monotonic, sleep=time.sleep,
+                k_worst: int = 3) -> dict:
     """Drive `engine` through the arrival stream; returns the summary dict
     (percentiles in ms; throughput over the wall window). Refused
     submissions never crash the run — backpressure (QueueFull) counts as
@@ -193,7 +226,29 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
     att = slo_attainment(engine, done)
     if att is not None:
         summary["slo_attainment"] = att
+        # SLO-class attainment COLLAPSE is an anomaly worth a post-mortem
+        # artifact, not just a percentage: freeze the flight ring while
+        # the pool/scheduler history that produced it is still in there
+        flight = getattr(engine, "flight", None)
+        if flight is not None:
+            for name, c in sorted(att.items()):
+                if c["completed"] >= 4 and c["attained"] < 0.5:
+                    flight.dump(
+                        {"kind": "slo_attainment_collapse",
+                         "slo_class": name, **c},
+                        tag="slo_collapse")
+    exemplars = worst_request_exemplars(engine, done, k=k_worst)
+    if exemplars is not None:
+        summary["worst_ttft_rids"] = [e["rid"]
+                                      for e in exemplars["worst_ttft"]]
+        summary["worst_tpot_rids"] = [e["rid"]
+                                      for e in exemplars["worst_tpot"]]
     if engine.writer is not None:
+        if exemplars is not None:
+            # the k-worst requests WITH their timelines as one event, so
+            # summarize_run.py renders the waterfall without re-joining
+            # request_trace records against percentile tails
+            engine.writer.event("request_exemplars", **exemplars)
         engine.writer.event("serving_summary", **summary)
         if "kv_util_mean" in stats:
             # token-granular occupancy as its own event stream, so the

@@ -175,6 +175,22 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     g.add_argument("--prompt_len_max", type=int, default=64)
     g.add_argument("--seed", type=int, default=0)
 
+    g = p.add_argument_group("observability")
+    g.add_argument("--trace_requests", action="store_true",
+                   help="per-request span timelines (obs/reqtrace.py): "
+                        "every request emits a request_trace event + a "
+                        "Chrome-trace track under --log_dir, and the "
+                        "summary carries the k-worst-TTFT/TPOT exemplars "
+                        "WITH their timelines (docs/OBSERVABILITY.md)")
+    g.add_argument("--flight_records", action="store_true",
+                   help="anomaly flight recorder (obs/flight.py): pool "
+                        "stats + scheduler decisions ring-buffered; "
+                        "PoolExhausted preemptions and SLO-attainment "
+                        "collapses dump flightdump_*.json to --log_dir")
+    g.add_argument("--flight_ring", type=int, default=512,
+                   help="--flight_records: ring capacity (events); "
+                        "0 disables the recorder (train.py semantics)")
+
     g = p.add_argument_group("other")
     g.add_argument("--log_dir", default="serve_logs",
                    help="obs output: trace.jsonl/trace.json spans + "
@@ -219,6 +235,25 @@ def get_serve_args(argv=None) -> argparse.Namespace:
         p.error("pick a weight source: --ckpt_dir, --random_init, or "
                 "--dry_run")
     return args
+
+
+def require_writable_dir(path: str, why: str) -> None:
+    """Loud up-front refusal when an obs output dir cannot take writes:
+    a traced run that silently drops its timelines is worse than no run
+    (the flags' whole point is the post-mortem artifact)."""
+    import os
+
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, ".obs_write_probe")
+        with open(probe, "w") as f:
+            f.write("ok")
+        os.remove(probe)
+    except OSError as e:
+        raise SystemExit(
+            f"{why}: trace output dir {path!r} is not writable "
+            f"({type(e).__name__}: {e}) — point --log_dir at a writable "
+            f"directory or drop the flag")
 
 
 def _load_params(args, model, mesh):
@@ -280,10 +315,16 @@ def _build_drafter(args, vocab_size: int, mesh, family: str):
 
 
 def serve(args: argparse.Namespace) -> dict:
-    from ..obs import SpanTracer
+    import time as _time
+
+    from ..obs import FlightRecorder, RequestTracer, SpanTracer
     from ..training.metrics import MetricsWriter
     from .engine import ContinuousBatchingEngine
     from .loadgen import replay_requests, run_loadgen, synthetic_requests
+
+    if args.trace_requests or args.flight_records:
+        require_writable_dir(
+            args.log_dir, "--trace_requests/--flight_records")
 
     eos_id = 1  # the shipped tokenizer's EOS (tokenizer/tokenizer.json)
     vocab_size = args.vocab_size
@@ -347,6 +388,11 @@ def serve(args: argparse.Namespace) -> dict:
 
     tracer = SpanTracer(args.log_dir, process_name="serve")
     writer = MetricsWriter(args.log_dir, process_index=0)
+    flight = (FlightRecorder(args.log_dir, maxlen=args.flight_ring)
+              if args.flight_records and args.flight_ring > 0 else None)
+    rt = (RequestTracer(writer=writer, tracer=tracer, flight=flight,
+                        clock=_time.monotonic)
+          if args.trace_requests else None)
     try:
         kv_dtype = None if args.kv_dtype == "native" else args.kv_dtype
         wdtype = (None if args.decode_weight_dtype == "native"
@@ -362,7 +408,8 @@ def serve(args: argparse.Namespace) -> dict:
                 decode_weight_dtype=wdtype,
                 slo_classes=parse_slo_classes(args.slo_classes),
                 default_class=args.default_class,
-                max_queue=args.queue_limit, tracer=tracer, writer=writer)
+                max_queue=args.queue_limit, tracer=tracer, writer=writer,
+                request_tracer=rt, flight=flight)
             if args.speculate:
                 from .speculative import SpeculativeEngine
                 dmodel, dparams = _build_drafter(args, cfg.vocab_size, mesh,
@@ -386,7 +433,8 @@ def serve(args: argparse.Namespace) -> dict:
                 max_queue=args.queue_limit,
                 debug_host_sampler=args.debug_host_sampler,
                 decode_weight_dtype=wdtype,
-                tracer=tracer, writer=writer)
+                tracer=tracer, writer=writer,
+                request_tracer=rt, flight=flight)
         summary = run_loadgen(engine, requests)
     finally:
         path = tracer.close()
@@ -439,13 +487,20 @@ def serve(args: argparse.Namespace) -> dict:
               "max_interleaved_prefill_positions", "slo_attainment",
               "speculate_k", "spec_rounds", "accepted_tokens_per_dispatch",
               "acceptance_rate", "acceptance_rate_by_position",
-              "rounds_per_request", "drafter_ms_total", "target_ms_total"):
+              "rounds_per_request", "drafter_ms_total", "target_ms_total",
+              "worst_ttft_rids", "worst_tpot_rids"):
         if k in summary:
             rec[k] = summary[k]
     if args.debug_host_sampler:
         rec["debug_host_sampler"] = True
     if args.decode_weight_dtype != "native":
         rec["decode_weight_dtype"] = args.decode_weight_dtype
+    if args.trace_requests:
+        rec["trace_requests"] = True
+    if flight is not None:
+        rec["flight_dumps"] = list(flight.dumps)
+        for d in flight.dumps:
+            print(f"flight dump written: {d}", file=sys.stderr)
     print(json.dumps(rec))
     return summary
 

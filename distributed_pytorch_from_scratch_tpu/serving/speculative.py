@@ -393,16 +393,18 @@ class SpeculativeEngine(PagedEngine):
             if self._dtbl[slot, j] == scratch:
                 self._dtbl[slot, j] = self._dalloc_page(slot)
 
-    def _release_slot(self, slot: int) -> None:
+    def _release_slot(self, slot: int) -> int:
         # retire/preempt frees BOTH page lists — the drafter's first, so a
         # preemption triggered from target-page pressure cannot leak the
         # drafter rows
         scratch = self.dpool.scratch_page
+        freed = 0
         for j in range(self._d_max_pages):
             if self._dtbl[slot, j] != scratch:
                 self.dpool.unref(int(self._dtbl[slot, j]))
                 self._dtbl[slot, j] = scratch
-        super()._release_slot(slot)
+                freed += 1
+        return freed + super()._release_slot(slot)
 
     # -- drafter prefill (admission and preempt-resume) -------------------
     def _drafter_prefill(self, slot: int, ids: List[int]) -> None:
@@ -441,6 +443,9 @@ class SpeculativeEngine(PagedEngine):
         # goes live (a preempt-resumed request passes through here too, so
         # both caches rebuild from the same prompt+generated prefix)
         self._drafter_prefill(slot, st.ids)
+        if self.rt is not None:
+            self.rt.mark(st.req, "drafter_prefill", self._clock(),
+                         positions=len(st.ids))
         super()._finish_prefill(slot, st, first, done)
 
     # -- the speculative decode round -------------------------------------
@@ -518,6 +523,13 @@ class SpeculativeEngine(PagedEngine):
         if self.tracer is not None:
             self.tracer.counter("slots_live", len(self._slot_req))
             self.tracer.counter("pages_in_use", used)
+        if self.flight is not None:
+            self.flight.record("pool_stats", live=len(self._slot_req),
+                               prefilling=len(self._prefilling),
+                               pages_in_use=used,
+                               free_pages=self.pool.free_pages,
+                               drafter_pages_in_use=self.dpool.pages_in_use,
+                               queued=self.scheduler.pending)
         for slot, req in list(self._slot_req.items()):
             na = int(n_acc[slot])
             n_att = min(k, int(qlen[slot]) - 1)
@@ -526,6 +538,11 @@ class SpeculativeEngine(PagedEngine):
                 self._acc_accept[j] += 1
             if na < n_att:
                 self._acc_attempt[na] += 1    # the first rejected draft
+            if self.rt is not None:
+                # one contiguous `spec_round` span per verify dispatch;
+                # `accepted` sums across coalesced rounds, so the retired
+                # timeline shows tokens-per-round at a glance
+                self.rt.mark(req, "spec_round", now, accepted=na)
             # the pending token was written at `pos` by the verify
             # dispatch: emitted (the non-speculative step's contract)
             req.tokens.append(int(self._tokens[slot]))
@@ -538,7 +555,9 @@ class SpeculativeEngine(PagedEngine):
                         or req.prompt_len + len(req.tokens) >= req.limit):
                     req.finish_t = now
                     del self._slot_req[slot]
-                    self._release_slot(slot)
+                    freed = self._release_slot(slot)
+                    if self.rt is not None:
+                        self.rt.note(req, pages_freed=freed)
                     self._complete(req, done)
                     finished = True
                     break

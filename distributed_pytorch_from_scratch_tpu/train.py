@@ -264,6 +264,11 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="hang watchdog: log a loud per-process report when "
                         "no dispatch completes for this many seconds "
                         "(0 disables)")
+    g.add_argument("--flight_ring", type=int, default=256,
+                   help="anomaly flight recorder: keep the last N spans/"
+                        "heartbeats in a ring that sentinel halts and "
+                        "watchdog stalls dump as flightdump_*.json "
+                        "(docs/OBSERVABILITY.md; 0 disables)")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -389,7 +394,7 @@ def train(args: argparse.Namespace) -> dict:
         logs_dir, writer=writer, trace=not args.no_trace,
         watchdog_secs=args.watchdog_secs, sentinel=not args.no_sentinel,
         spike_factor=args.sentinel_spike_factor,
-        process_index=proc_idx)
+        process_index=proc_idx, flight_ring=args.flight_ring)
 
     try:
         dataloader = get_dataloader(args.data_path, args.batch_size,

@@ -70,9 +70,13 @@ class MetricsWriter:
 
     def event(self, tag: str, step: Optional[int] = None, **fields) -> None:
         """Structured one-off record (goodput summary, sentinel/watchdog
-        events, cost analysis) — jsonl only; TB has no sane rendering for
-        these."""
-        rec = {"tag": tag, "ts": time.time(), **fields}
+        events, cost analysis, request traces) — jsonl only; TB has no
+        sane rendering for these. Every event carries `schema_version`
+        (obs/schema.py) so consumers can fail loudly on drift instead of
+        silently dropping sections."""
+        from ..obs.schema import EVENT_SCHEMA_VERSION
+        rec = {"tag": tag, "ts": time.time(),
+               "schema_version": EVENT_SCHEMA_VERSION, **fields}
         if step is not None:
             rec["step"] = int(step)
         self._write(rec)

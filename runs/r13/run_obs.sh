@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-13 observability session (ISSUE 10): land a fresh trajectory
+# point THROUGH the new regression gate, and exercise the request-level
+# tracing + flight recorder on real chips.
+#   1. trajectory + gate — the 45m fast-path bench line, then
+#      scripts/check_bench_regression.py compares it against the
+#      committed BENCH_r*.json trajectory (tokens/s + MFU proxy within
+#      tolerance bands; backend_unavailable records skip instead of
+#      failing — the BENCH_r05 lesson). A nonzero gate rc lands in the
+#      manifest as forensics, it does not abort the session.
+#   2. traced serving loadgen — serve.py --paged with --trace_requests
+#      and --flight_records: every request emits its span timeline, the
+#      k-worst TTFT/TPOT exemplars land in the summary, and any
+#      PoolExhausted preemption / SLO-attainment collapse freezes the
+#      flight ring into runs/r13/serve_logs/flightdump_*.json. The tight
+#      page pool (slots oversubscribe num_pages) makes preemption likely
+#      under the burst, so the session should come home with a dump.
+#   3. traced serving bench — the 3-way A/B with the paged arm traced
+#      (bench_obs artifacts ride home with the record).
+# Weights are random inits (timeline/flight behaviour is value-free);
+# correctness is pinned by CPU tests (tests/test_obs_v2.py). Idempotent;
+# reuses the round-5 session helpers (step/bench_line artifact guards,
+# SESSION_DEADLINE chokepoint via scripts/run_step.py).
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r13
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r13 obs pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 1. fresh trajectory point + the regression gate against BENCH_r*.json
+bench_line 45mfast 1200 --model 45m --remat auto --seq_bucket 128 --steps_per_dispatch 16
+step gate 120 python scripts/check_bench_regression.py --fresh runs/r13/bench_45mfast.json
+
+# 2. traced + flight-recorded serving loadgen (tight pool -> preemptions)
+step servetrace 900 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --paged --trace_requests --flight_records --num_requests 48 --rate 16 --slots 12 --num_pages 24 --page_size 16 --max_new_tokens 48 --prompt_len_min 8 --prompt_len_max 96 --class_mix interactive=1,standard=2,batch=1 --tenants 3 --log_dir runs/r13/serve_logs
+
+# 3. the serving A/B with the paged arm traced
+bench_line servingtrace 1200 --serving --trace_requests --flight_records --obs_dir runs/r13/bench_obs
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r13 obs done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -1,0 +1,228 @@
+"""Bench-regression gate: compare a fresh bench record against the
+committed BENCH_*.json trajectory with per-metric tolerance bands
+(ISSUE 10).
+
+The repo has a growing perf trajectory (tokens/s, MFU proxy, serving
+TTFT/TPOT p95, comm-exposed ms) but until now no automated way to notice
+when a PR regresses it — the ROADMAP's "land their numbers before
+trusting any speedup claim" caveat in executable form. This gate:
+
+* loads the FRESH record (a `bench.py` stdout JSON line, a
+  `runs/rN/bench_*.json` artifact, or a committed `BENCH_rNN.json`
+  wrapper — all three shapes are recognised),
+* picks the most recent COMPARABLE baseline from the committed
+  trajectory (same `unit`, exact `metric`-string match preferred,
+  error records skipped — an outage is not a baseline),
+* checks each metric against its tolerance band in its GOOD direction
+  (throughput must not drop, latency/exposed-comm must not grow), and
+* exits 0 on pass, **1 on regression**, and 0-with-skip when the fresh
+  record is a `backend_unavailable` outage — an environment fact, not a
+  regression (the BENCH_r05 lesson: rc != 0 throws away the artifact).
+
+Wired into the staged `runs/` scripts (runs/r13/run_obs.sh) and
+preflighted by tests/test_staged_session.py like every other staged
+command. One machine-readable JSON line on stdout; human detail on
+stderr.
+
+Usage:
+    python scripts/check_bench_regression.py --fresh runs/r13/bench_x.json
+    python scripts/check_bench_regression.py --fresh new.json \
+        --baseline BENCH_r01.json --tol_pct 15
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metric field -> direction ("up" = bigger is better). `value` resolves
+# per-unit below. Tolerances are fractions of the baseline.
+LOWER_BETTER_UNITS = ("ms/step", "ms/step (analytic)")
+THROUGHPUT_FIELDS = ("value", "vs_baseline", "paged_vs_slot",
+                     "accepted_tokens_per_dispatch")
+LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95")
+
+
+def load_record(path):
+    """One bench record from any of the trajectory's on-disk shapes:
+    a BENCH_rNN.json wrapper ({"parsed": {...}}), a bare bench JSON
+    object, or a text/jsonl artifact whose LAST parseable JSON-object
+    line is the record (bench.py prints diagnostics before the line)."""
+    text = open(path).read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            if "parsed" in doc and isinstance(doc["parsed"], dict):
+                return doc["parsed"]
+            if "metric" in doc or "error" in doc:
+                return doc
+    except ValueError:
+        pass
+    rec = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and ("metric" in obj or "error" in obj):
+            rec = obj
+    if rec is None:
+        raise SystemExit(f"no bench record found in {path} (expected a "
+                         f"JSON object with 'metric' or 'error')")
+    return rec
+
+
+def default_baselines():
+    """The committed trajectory, in round order (BENCH_r01, r02, ...)."""
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+
+
+def pick_baseline(fresh, paths):
+    """Most recent comparable committed record: same `unit`, exact
+    `metric` string preferred (later rounds win either way); outage
+    records are skipped. Returns (record, path) or (None, None)."""
+    best = exact = None
+    for p in paths:
+        try:
+            rec = load_record(p)
+        except (OSError, SystemExit):
+            continue
+        if "error" in rec or "metric" not in rec:
+            continue  # an outage is not a baseline
+        if rec.get("unit") != fresh.get("unit"):
+            continue
+        best = (rec, p)
+        if rec.get("metric") == fresh.get("metric"):
+            exact = (rec, p)
+    return exact or best or (None, None)
+
+
+def _get(rec, dotted):
+    cur = rec
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def metric_checks(fresh, base, tol_pct, tol_latency_pct):
+    """Per-metric comparisons for the pair's unit. Each check:
+    {field, fresh, base, direction, tol_pct, ok}. A field absent on
+    either side is skipped (older trajectory records predate some
+    fields) — skipping is visible in the output, never silent."""
+    unit = fresh.get("unit", "")
+    fields = []
+    if unit in LOWER_BETTER_UNITS:
+        fields.append(("value", "down", tol_latency_pct))
+        fields.append(("attribution.comm.exposed_ms", "down",
+                       tol_latency_pct))
+        fields.append(("comm.exposed_ms", "down", tol_latency_pct))
+    else:
+        for f in THROUGHPUT_FIELDS:
+            fields.append((f, "up", tol_pct))
+        for f in LATENCY_FIELDS:
+            fields.append((f, "down", tol_latency_pct))
+    checks, skipped = [], []
+    for field, direction, tol in fields:
+        fv, bv = _get(fresh, field), _get(base, field)
+        if not isinstance(fv, (int, float)) or not isinstance(bv,
+                                                              (int, float)):
+            if fv is not None or bv is not None:
+                skipped.append(field)
+            continue
+        if bv == 0:
+            skipped.append(field)
+            continue
+        if direction == "up":
+            ok = fv >= bv * (1.0 - tol / 100.0)
+        else:
+            ok = fv <= bv * (1.0 + tol / 100.0)
+        checks.append({"field": field, "fresh": fv, "base": bv,
+                       "direction": direction, "tol_pct": tol, "ok": ok})
+    return checks, skipped
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--fresh", required=True,
+                   help="the new bench record (bench.py stdout JSON line, "
+                        "runs/rN/bench_*.json artifact, or BENCH_rNN.json)")
+    p.add_argument("--baseline", nargs="*", default=None,
+                   help="baseline record file(s); default: the committed "
+                        "BENCH_r*.json trajectory at the repo root")
+    p.add_argument("--tol_pct", type=float, default=10.0,
+                   help="throughput tolerance band (%% below baseline "
+                        "that still passes)")
+    p.add_argument("--tol_latency_pct", type=float, default=25.0,
+                   help="latency / exposed-comm tolerance band (%% above "
+                        "baseline that still passes)")
+    return p.parse_args(argv)
+
+
+def run(args) -> int:
+    fresh = load_record(args.fresh)
+    out = {"gate": "bench_regression", "fresh": args.fresh}
+    if "error" in fresh:
+        if fresh["error"] == "backend_unavailable":
+            # an outage is an ENVIRONMENT fact: skip, don't fail — the
+            # gate must not turn a tunnel drop into a fake regression
+            out.update(status="skip", reason="backend_unavailable",
+                       detail=fresh.get("detail"))
+            print(json.dumps(out))
+            print(f"gate: SKIP — fresh record is a backend_unavailable "
+                  f"outage ({fresh.get('detail')})", file=sys.stderr)
+            return 0
+        out.update(status="error", reason=fresh["error"],
+                   detail=fresh.get("detail"))
+        print(json.dumps(out))
+        print(f"gate: FAIL — fresh record carries a non-outage error: "
+              f"{fresh['error']}", file=sys.stderr)
+        return 1
+    paths = (args.baseline if args.baseline is not None
+             else default_baselines())
+    base, base_path = pick_baseline(fresh, paths)
+    if base is None:
+        out.update(status="no_baseline", unit=fresh.get("unit"),
+                   searched=len(paths))
+        print(json.dumps(out))
+        print(f"gate: no comparable baseline (unit {fresh.get('unit')!r} "
+              f"across {len(paths)} trajectory files) — passing; commit "
+              f"this record to start the trajectory", file=sys.stderr)
+        return 0
+    checks, skipped = metric_checks(fresh, base, args.tol_pct,
+                                    args.tol_latency_pct)
+    regressions = [c for c in checks if not c["ok"]]
+    out.update(status="regression" if regressions else "ok",
+               baseline=base_path, baseline_metric=base.get("metric"),
+               checks=checks, skipped_fields=skipped)
+    print(json.dumps(out))
+    for c in checks:
+        arrow = {"up": ">=", "down": "<="}[c["direction"]]
+        verdict = "ok" if c["ok"] else "REGRESSION"
+        print(f"gate: {c['field']}: fresh {c['fresh']} {arrow} baseline "
+              f"{c['base']} (tol {c['tol_pct']:g}%) — {verdict}",
+              file=sys.stderr)
+    if skipped:
+        print(f"gate: skipped (absent on one side): {', '.join(skipped)}",
+              file=sys.stderr)
+    if regressions:
+        print(f"gate: FAIL — {len(regressions)} metric(s) regressed vs "
+              f"{base_path}", file=sys.stderr)
+        return 1
+    print(f"gate: PASS vs {base_path}", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    return run(parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,6 +220,141 @@ def serving_lines(rdir):
     return rows
 
 
+def _iter_events(rdir, tags):
+    """(relpath, record) for every matching event across the runs dir's
+    metrics*.jsonl files (the multihost proc-tagged filenames included)."""
+    for p in sorted(glob.glob(os.path.join(rdir, "**", "metrics*.jsonl"),
+                              recursive=True)):
+        rel = os.path.relpath(p, rdir)
+        for line in open(p, errors="replace"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("tag") in tags:
+                yield rel, rec
+
+
+def _fmt_timeline(spans, max_spans=8):
+    """One waterfall line from a request's coalesced span list."""
+    if not spans:
+        return "(no timeline)"
+    parts = []
+    for s in spans[:max_spans]:
+        extra = []
+        if s.get("count", 1) > 1:
+            extra.append(f"x{s['count']}")
+        if s.get("positions"):
+            extra.append(f"{s['positions']} pos")
+        if s.get("accepted"):
+            extra.append(f"{s['accepted']} acc")
+        if s.get("cow"):
+            extra.append(f"{s['cow']} cow")
+        parts.append(f"{s.get('name')} {s.get('dur_ms')}ms"
+                     + (f" ({', '.join(extra)})" if extra else ""))
+    tail = "" if len(spans) <= max_spans else f" -> ... ({len(spans)} spans)"
+    return " -> ".join(parts) + tail
+
+
+def request_lines(rdir):
+    """Slowest-request waterfalls from `request_exemplars` events
+    (serving/loadgen.py): the k-worst TTFT/TPOT requests with their
+    admit->first-token span breakdown — an SLO miss with a WHY."""
+    rows = []
+    for rel, rec in _iter_events(rdir, ("request_exemplars",)):
+        for kind, label in (("worst_ttft", "TTFT"), ("worst_tpot", "TPOT")):
+            for e in rec.get(kind) or []:
+                lat = e.get("ttft_ms") if kind == "worst_ttft" \
+                    else e.get("tpot_ms")
+                rows.append(
+                    f"- `{rel}` worst {label} rid {e.get('rid')} "
+                    f"({label.lower()} {lat}ms"
+                    + (f", {e['preemptions']} preempted"
+                       if e.get("preemptions") else "")
+                    + f"): {_fmt_timeline(e.get('timeline'))}")
+    return rows
+
+
+def flight_lines(rdir):
+    """Pointers to anomaly flight dumps (obs/flight.py) under the runs
+    dir, with their trigger — the post-mortem starts HERE, not in
+    TensorBoard scrollback."""
+    rows = []
+    for p in sorted(glob.glob(os.path.join(rdir, "**", "flightdump_*.json"),
+                              recursive=True)):
+        rel = os.path.relpath(p, rdir)
+        try:
+            doc = json.loads(open(p, errors="replace").read())
+            trig = doc.get("trigger", {})
+            rows.append(f"- `{rel}`: {trig.get('kind', '?')} "
+                        f"({len(doc.get('ring', []))} ring events"
+                        + (f", {doc['dumps_skipped']} further dumps capped"
+                           if doc.get("dumps_skipped") else "") + ")"
+                        + (f" — victim rid {trig['victim_rid']}"
+                           if "victim_rid" in trig else "")
+                        + (f" — {trig['reason']}"
+                           if "reason" in trig else ""))
+        except (ValueError, OSError) as e:
+            rows.append(f"- `{rel}`: unparseable ({e})")
+    return rows
+
+
+def skew_lines(rdir):
+    """Per-rank phase-skew table from `rank_phase_stats` events (one per
+    process; obs/attribution.rank_skew ranks the straggler suspects)."""
+    recs = [rec for _, rec in _iter_events(rdir, ("rank_phase_stats",))]
+    if len(recs) < 2:
+        return []
+    try:
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from distributed_pytorch_from_scratch_tpu.obs.attribution import (
+            rank_skew)
+    except ImportError as e:
+        return [f"(rank_phase_stats present but attribution import "
+                f"failed: {e})"]
+    report = rank_skew(recs)
+    if report is None:
+        return []
+    rows = ["| phase | mean s | max s | worst rank | skew |", "|---|---|---|---|---|"]
+    for phase, d in sorted(report["phases"].items(),
+                           key=lambda kv: -kv[1]["max_s"]):
+        if d["max_s"] <= 0:
+            continue
+        rows.append(f"| {phase} | {d['mean_s']:.3f} | {d['max_s']:.3f} "
+                    f"| p{d['max_process']} | {d['skew']*100:.0f}% |")
+    for s in report["suspects"][:5]:
+        rows.append(f"- straggler suspect: p{s['process']} in "
+                    f"`{s['phase']}` — {s['excess_s']:.3f}s over the mean "
+                    f"(x{s['ratio']})")
+    if report["persistent"]:
+        rows.append(f"- PERSISTENT skew: rank(s) "
+                    f"{', '.join('p%d' % p for p in report['persistent'])} "
+                    f"worst in >= 2 phases — suspect the host, not noise")
+    return rows
+
+
+def schema_warning_lines(rdir):
+    """Event-schema drift, surfaced loudly (obs/schema.py): a consumer
+    silently dropping a section is how observability rots."""
+    try:
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from distributed_pytorch_from_scratch_tpu.obs.schema import (
+            validate_jsonl)
+    except ImportError:
+        return []
+    rows = []
+    for p in sorted(glob.glob(os.path.join(rdir, "**", "metrics*.jsonl"),
+                              recursive=True)):
+        rel = os.path.relpath(p, rdir)
+        problems = validate_jsonl(p, max_problems=5)
+        rows.extend(f"- `{rel}` {prob}" for prob in problems)
+    return rows
+
+
 def manifest_failures(rdir):
     """Steps that failed, from the run_step manifest — forensics inline."""
     path = os.path.join(rdir, "session_manifest.jsonl")
@@ -269,6 +404,28 @@ def summarize(rdir):
         out.append("")
         out.append("Serving (continuous batching, serving/):")
         out.extend(serving)
+    waterfalls = request_lines(rdir)
+    if waterfalls:
+        out.append("")
+        out.append("Slowest requests (per-request span waterfall):")
+        out.extend(waterfalls)
+    flights = flight_lines(rdir)
+    if flights:
+        out.append("")
+        out.append("Anomaly flight dumps (obs/flight.py — read these "
+                   "before TensorBoard):")
+        out.extend(flights)
+    skew = skew_lines(rdir)
+    if skew:
+        out.append("")
+        out.append("Cross-rank phase skew (rank_phase_stats):")
+        out.extend(skew)
+    drift = schema_warning_lines(rdir)
+    if drift:
+        out.append("")
+        out.append("METRICS SCHEMA DRIFT (sections above may be "
+                   "incomplete — fix the producer or the reader):")
+        out.extend(drift)
     vals, decodes = eval_summary(rdir)
     if vals:
         out.append("")

@@ -145,6 +145,22 @@ CORE_LANE = {
                     "test_sentinel_nan_halts_with_dump",
                     "test_watchdog_detects_stall_and_recovery",
                     "test_parse_collectives_counts_and_bytes"],
+    # obs v2 (ISSUE 10): the contiguous-timeline acceptance pin (one tiny
+    # compile), the flight ring bound + PoolExhausted dump pin, the
+    # regression-gate trio, the schema-drift guard, the rank-skew unit,
+    # and the traced-serve CLI rot guard
+    "test_obs_v2.py": [
+        "test_paged_request_timelines_contiguous_and_sum_to_wall",
+        "test_flight_ring_bound_holds_under_sustained_load",
+        "test_pool_exhausted_preemption_dumps_flight",
+        "test_gate_passes_on_committed_trajectory_vs_itself",
+        "test_gate_fails_on_degraded_record",
+        "test_gate_skips_on_backend_unavailable",
+        "test_metrics_events_carry_schema_version_and_validate",
+        "test_schema_validator_fails_loudly_on_drift",
+        "test_rank_skew_ranks_stragglers",
+        "test_serve_dry_run_with_tracing_and_flight",
+    ],
 }
 
 

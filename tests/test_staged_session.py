@@ -26,14 +26,16 @@ R5 = os.path.join(REPO, "runs", "r5")
 # r7 the comm-overlap A/B, r8 the serving loadgen sweep, r9 the paged
 # serving-v2 sweep + slot-vs-paged A/B, r10 the speculative k-sweep +
 # fused-sampler ablation, r11 the int8 wire sweep + int8-KV serving arms,
-# r12 the ZeRO stage x wire ladder + RS/AG breakdown arm)
+# r12 the ZeRO stage x wire ladder + RS/AG breakdown arm, r13 the
+# regression-gated trajectory point + traced/flight-recorded serving)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
                             os.path.join(REPO, "runs", "r9"),
                             os.path.join(REPO, "runs", "r10"),
                             os.path.join(REPO, "runs", "r11"),
-                            os.path.join(REPO, "runs", "r12"))
+                            os.path.join(REPO, "runs", "r12"),
+                            os.path.join(REPO, "runs", "r13"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
@@ -176,7 +178,8 @@ def validate(argv):
         return _parse_with(bench.parse_args, rest)
     if prog.startswith("scripts/") and prog.endswith(".py"):
         name = os.path.basename(prog)[:-3]
-        if name in ("tpu_checks", "make_image_corpus", "tune_flash_blocks"):
+        if name in ("tpu_checks", "make_image_corpus", "tune_flash_blocks",
+                    "check_bench_regression"):
             mod = _load_script(name)
             return _parse_with(mod.parse_args, rest)
         if name == "run_step":
