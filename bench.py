@@ -40,8 +40,8 @@ import time
 import jax
 import jax.numpy as jnp
 
-from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
-                                                  Transformer, make_mesh)
+from distributed_pytorch_from_scratch_tpu import (MeshConfig, Transformer,
+                                                  make_mesh)
 from distributed_pytorch_from_scratch_tpu.config import (IGNORE_INDEX,
                                                          REMAT_CHOICES,
                                                          OptimizerConfig,

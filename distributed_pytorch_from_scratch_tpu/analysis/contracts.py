@@ -1,0 +1,456 @@
+"""graftcheck layer 2: trace contracts over compiled programs.
+
+Parses the optimized HLO of each canonical program (`programs.py`) into a
+per-axis collective inventory — op kind, element dtype, payload bytes, and
+the MESH AXIS each collective runs over (classified from replica_groups /
+source_target_pairs against the mesh's device grid) — then asserts:
+
+* the inventory matches `obs/attribution.expected_collectives` for the
+  program's config (require/allow/forbid sets over (axis, op) pairs);
+* int8-wire programs carry no wide-dtype payload on the dp axis beyond
+  the scale sidecars (the "int8 silently falls back to f32" hazard);
+* ZeRO-3 programs contain no dp-axis all-gather at all — the per-layer
+  ring is collective-permute; a dp all-gather would be the whole-tree
+  param gather the stage exists to eliminate;
+* declared donations actually alias in the compiled executable (the
+  input_output_alias map covers every donated leaf — a dtype/shape change
+  that silently un-donates shows up here, not as a quiet 2x footprint);
+* knobs that shouldn't recompile don't: lowering the same program from
+  different host-side values must produce byte-identical HLO.
+
+Pure text analysis over `Program` records — jax is only reached through
+`programs.py`'s lazy builders.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from ..obs.introspect import _DTYPE_BYTES
+
+_COLL_RE = re.compile(
+    r"=\s+(?P<shape>[^=\n]*?)\s*"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute|collective-broadcast)(?P<start>-start)?"
+    r"\((?P<rest>[^\n]*)")
+
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{.*?\}\}|\[[\d,]+\]<=\[[\d,]+\](?:T\([\d,]+\))?)")
+_PAIRS_RE = re.compile(r"source_target_pairs=(\{\{.*?\}\})")
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+
+
+def _parse_braced_groups(text: str) -> List[Tuple[int, ...]]:
+    """'{{0,2},{1,3}}' -> [(0,2),(1,3)]"""
+    return [tuple(int(x) for x in grp.split(",") if x != "")
+            for grp in re.findall(r"\{([\d,]*)\}", text[1:-1])]
+
+
+def _parse_iota_groups(text: str) -> List[Tuple[int, ...]]:
+    """XLA's v2 format: '[G,S]<=[dims]T(perm)' — reshape(transpose(iota))."""
+    m = re.match(r"\[([\d,]+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?", text)
+    shape = [int(x) for x in m.group(1).split(",")]
+    src = [int(x) for x in m.group(2).split(",")]
+    n = 1
+    for d in src:
+        n *= d
+    ids = list(range(n))
+    if m.group(3):
+        perm = [int(x) for x in m.group(3).split(",")]
+        # index math without numpy: transpose the src-shaped iota
+        strides = [0] * len(src)
+        acc = 1
+        for i in range(len(src) - 1, -1, -1):
+            strides[i] = acc
+            acc *= src[i]
+        dims = [src[p] for p in perm]
+        out = []
+
+        def rec(prefix):
+            if len(prefix) == len(dims):
+                flat = sum(prefix[i] * strides[perm[i]]
+                           for i in range(len(dims)))
+                out.append(flat)
+                return
+            for j in range(dims[len(prefix)]):
+                rec(prefix + [j])
+
+        rec([])
+        ids = out
+    g, s = shape[0], shape[1] if len(shape) > 1 else 1
+    return [tuple(ids[i * s:(i + 1) * s]) for i in range(g)]
+
+
+@dataclasses.dataclass
+class Collective:
+    op: str
+    axis: str          # mesh axis name, 'all', 'mixed', or 'local'
+    dtype: str         # widest member dtype ('f32', 's8', ...)
+    bytes: int         # payload bytes (largest member for -start tuples)
+    line: str
+
+
+def _axis_groups(mesh) -> Dict[str, FrozenSet[FrozenSet[int]]]:
+    """axis name -> the set of device-id groups a collective over exactly
+    that axis uses (only axes of size > 1)."""
+    import numpy as np
+    ids = np.vectorize(lambda d: d.id)(mesh.devices)
+    names = list(mesh.axis_names)
+    out = {}
+    for i, name in enumerate(names):
+        if ids.shape[i] <= 1:
+            continue
+        moved = np.moveaxis(ids, i, -1).reshape(-1, ids.shape[i])
+        out[name] = frozenset(frozenset(int(x) for x in row)
+                              for row in moved)
+    out["all"] = frozenset({frozenset(int(x) for x in ids.flatten())})
+    return out
+
+
+def _classify(groups: List[Tuple[int, ...]],
+              axis_groups: Dict[str, FrozenSet[FrozenSet[int]]]) -> str:
+    sizes = {len(g) for g in groups}
+    if sizes <= {1}:
+        return "local"      # singleton groups: no wire traffic at all
+    gset = frozenset(frozenset(g) for g in groups if len(g) > 1)
+    for name, ref in axis_groups.items():
+        if gset <= ref:
+            return name
+    return "mixed"
+
+
+def _classify_pairs(pairs: List[Tuple[int, ...]],
+                    axis_groups: Dict[str, FrozenSet[FrozenSet[int]]]
+                    ) -> str:
+    """A permute's axis: every (src, dst) pair must sit inside one of the
+    axis's groups."""
+    for name, ref in axis_groups.items():
+        if name == "all":
+            continue
+        if all(any({s, t} <= g for g in ref) for s, t in pairs):
+            return name
+    if all(any(set(p) <= g for g in axis_groups["all"]) for p in pairs):
+        return "all"
+    return "mixed"
+
+
+def _shape_members(shape: str) -> List[Tuple[str, int]]:
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(shape):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append((dtype, n * size))
+    return out
+
+
+def parse_collectives_by_axis(hlo_text: str, mesh) -> List[Collective]:
+    ag = _axis_groups(mesh)
+    out = []
+    for m in _COLL_RE.finditer(hlo_text):
+        rest = m.group("rest")
+        gm = _GROUPS_RE.search(rest)
+        pm = _PAIRS_RE.search(rest)
+        if gm:
+            text = gm.group(1)
+            groups = (_parse_braced_groups(text) if text.startswith("{")
+                      else _parse_iota_groups(text))
+            axis = _classify(groups, ag)
+        elif pm:
+            pairs = _parse_braced_groups(pm.group(1))
+            pairs = [p for p in pairs if len(p) == 2 and p[0] != p[1]]
+            axis = _classify_pairs(pairs, ag) if pairs else "local"
+        else:
+            axis = "all"    # no groups attr = one group of every device
+        members = _shape_members(m.group("shape"))
+        if not members:
+            continue
+        # async -start forms carry (operands..., result, context) tuple
+        # shapes; the largest member is the payload either way
+        dtype, nbytes = max(members, key=lambda kv: kv[1])
+        out.append(Collective(op=m.group("op"), axis=axis, dtype=dtype,
+                              bytes=nbytes, line=m.group(0)[:160]))
+    return out
+
+
+def inventory(colls: List[Collective]) -> Dict[Tuple[str, str], Dict]:
+    """(axis, op) -> {count, bytes, max_bytes, dtypes} — wire-carrying
+    collectives only ('local' singleton groups move no bytes)."""
+    out: Dict[Tuple[str, str], Dict] = {}
+    for c in colls:
+        if c.axis == "local":
+            continue
+        rec = out.setdefault((c.axis, c.op),
+                             {"count": 0, "bytes": 0, "max_bytes": 0,
+                              "dtypes": set()})
+        rec["count"] += 1
+        rec["bytes"] += c.bytes
+        rec["max_bytes"] = max(rec["max_bytes"], c.bytes)
+        rec["dtypes"].add(c.dtype)
+    return out
+
+
+def _result(name: str, ok: bool, detail: str,
+            program: Optional[str] = None) -> dict:
+    return {"name": name, "ok": bool(ok), "detail": detail,
+            "program": program}
+
+
+# ------------------------------------------------------------- contracts --
+
+#: payloads at or below this are bookkeeping (loss scalars, quant scales,
+#: cursor fields), not wire schedules — the inventory contract ignores
+#: their dtype, the int8-width contract exempts them
+SCALE_SIDECAR_BYTES = 256
+
+
+def check_collective_inventory(prog, expected: Dict) -> dict:
+    """Observed (axis, op) inventory vs the expected_collectives schedule:
+    every REQUIRED pair present, nothing outside REQUIRED|ALLOWED, no
+    FORBIDDEN pair, and per-pair wire dtypes within the declared set."""
+    colls = parse_collectives_by_axis(prog.compiled_text, prog.mesh)
+    inv = inventory(colls)
+    problems = []
+    req = {tuple(k): v for k, v in expected["require"].items()}
+    allow = {tuple(k) for k in expected["allow"]}
+    forbid = {tuple(k) for k in expected["forbid"]}
+    for key, spec in req.items():
+        if key not in inv:
+            problems.append(f"missing required collective {key}: "
+                            f"{spec.get('note', '')}")
+            continue
+        want = spec.get("dtypes")
+        if want:
+            got = {d for c in colls
+                   if (c.axis, c.op) == key
+                   and c.bytes > SCALE_SIDECAR_BYTES
+                   for d in (c.dtype,)}
+            extra = got - set(want)
+            if extra:
+                problems.append(
+                    f"{key} carries {sorted(extra)} payloads; schedule "
+                    f"prices {sorted(want)} ({spec.get('note', '')})")
+    for key in inv:
+        if key in forbid:
+            problems.append(
+                f"forbidden collective {key} present "
+                f"({inv[key]['count']}x, {inv[key]['max_bytes']}B max): "
+                f"{expected['forbid'][key]}")
+        elif key not in req and key not in allow:
+            if inv[key]["max_bytes"] > SCALE_SIDECAR_BYTES:
+                problems.append(
+                    f"unexpected collective {key} "
+                    f"({inv[key]['count']}x, {inv[key]['max_bytes']}B "
+                    f"max) — not in the priced schedule; either the "
+                    f"program grew a wire the attribution doesn't price "
+                    f"or expected_collectives needs updating WITH the "
+                    f"pricing")
+    detail = ("; ".join(problems) if problems else
+              "inventory == priced schedule: " + ", ".join(
+                  f"{a}/{o} x{v['count']}"
+                  for (a, o), v in sorted(inv.items())))
+    return _result("collective-inventory", not problems, detail, prog.name)
+
+
+def check_no_wide_dp_wire(prog, axis: str = "dp",
+                          allowed_ops: Tuple[str, ...] = ()) -> dict:
+    """int8-wire contract: every collective on `axis` carrying more than
+    the scale sidecar must be 8-bit. `allowed_ops` exempts ops the
+    schedule prices as f32 by design (e.g. the ZeRO-2 param all-gather)."""
+    colls = parse_collectives_by_axis(prog.compiled_text, prog.mesh)
+    wide = [c for c in colls
+            if c.axis == axis and c.op not in allowed_ops
+            and c.bytes > SCALE_SIDECAR_BYTES
+            and not c.dtype.endswith("8")]
+    narrow = [c for c in colls
+              if c.axis == axis and c.dtype.endswith("8")]
+    if wide:
+        worst = max(wide, key=lambda c: c.bytes)
+        return _result(
+            "int8-wire-width", False,
+            f"{len(wide)} wide collective(s) on the {axis} axis — e.g. "
+            f"{worst.op} {worst.dtype} {worst.bytes}B: the int8 wire "
+            f"silently fell back", prog.name)
+    if not narrow:
+        return _result(
+            "int8-wire-width", False,
+            f"no 8-bit collective found on the {axis} axis at all — the "
+            f"quantized ring never ran", prog.name)
+    return _result(
+        "int8-wire-width", True,
+        f"{len(narrow)} s8 collective(s) on {axis}, widest non-sidecar "
+        f"payload is 8-bit", prog.name)
+
+
+def check_zero3_no_whole_tree_gather(prog) -> dict:
+    """ZeRO-3: no dp-axis all-gather at all — the per-layer ring is
+    collective-permute inside the scan; a dp all-gather is the whole-tree
+    param materialisation the stage exists to eliminate."""
+    colls = parse_collectives_by_axis(prog.compiled_text, prog.mesh)
+    bad = [c for c in colls if c.axis == "dp" and c.op == "all-gather"]
+    rings = [c for c in colls
+             if c.axis == "dp" and c.op == "collective-permute"]
+    if bad:
+        worst = max(bad, key=lambda c: c.bytes)
+        return _result(
+            "zero3-no-whole-tree-gather", False,
+            f"{len(bad)} dp-axis all-gather(s) in a ZeRO-3 program "
+            f"(largest {worst.bytes}B) — params are materialising "
+            f"whole-tree instead of ringing per layer", prog.name)
+    if not rings:
+        return _result(
+            "zero3-no-whole-tree-gather", False,
+            "no dp-axis collective-permute found — the per-layer gather "
+            "ring is missing entirely", prog.name)
+    return _result(
+        "zero3-no-whole-tree-gather", True,
+        f"no dp all-gather; {len(rings)} dp ring permute(s) (the "
+        f"per-layer gathers + their reduce-scatter transposes)", prog.name)
+
+
+_ALIAS_ENTRY = re.compile(r"\{[\d,\s]*\}:\s*\((\d+),")
+
+
+def donated_param_indices(compiled_text: str) -> List[int]:
+    """Flat parameter indices the compiled executable aliases in place."""
+    m = re.search(r"input_output_alias=\{", compiled_text)
+    if not m:
+        return []
+    # brace-match from the opening '{'
+    i = m.end() - 1
+    depth = 0
+    for j in range(i, min(len(compiled_text), i + 200000)):
+        ch = compiled_text[j]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                body = compiled_text[i:j + 1]
+                return sorted(int(x) for x in
+                              _ALIAS_ENTRY.findall(body))
+    return []
+
+
+def check_donation_aliased(prog) -> dict:
+    """Every donated leaf must appear in the executable's
+    input_output_alias map — XLA silently DROPS a donation whose aval
+    matches no output (the quiet 2x-footprint failure)."""
+    aliased = set(donated_param_indices(prog.compiled_text))
+    want = set(range(prog.donated_flat_start, prog.donated_flat_stop))
+    missing = want - aliased
+    if missing:
+        return _result(
+            "donation-aliased", False,
+            f"{len(missing)}/{len(want)} donated input leaf(s) not "
+            f"aliased in the executable (flat indices "
+            f"{sorted(missing)[:8]}...) — the donation silently became "
+            f"a copy", prog.name)
+    return _result(
+        "donation-aliased", True,
+        f"all {len(want)} donated leaves alias outputs in-place "
+        f"({len(aliased)} aliased inputs total)", prog.name)
+
+
+def check_stable_lowering(name: str, texts: List[str]) -> dict:
+    """Recompile-hazard probe: the same program lowered from different
+    host-side values (same shapes/dtypes) must produce byte-identical
+    StableHLO — a difference means a host value was baked in as a
+    constant, and the serving loop would recompile per step."""
+    distinct = len(set(texts))
+    if distinct != 1:
+        return _result(
+            "recompile-hazard", False,
+            f"{name}: {distinct} distinct lowerings from {len(texts)} "
+            f"same-shaped argument sets — a host value is baked into the "
+            f"program and will force recompiles", name)
+    return _result(
+        "recompile-hazard", True,
+        f"{name}: 1 lowering across {len(texts)} same-shaped argument "
+        f"sets", name)
+
+
+# ------------------------------------------------------------ the runner --
+
+def run_trace_contracts(full: bool = False) -> List[dict]:
+    """Build the canonical programs and run every contract. `full` adds
+    the slower sweep (all zero stages x wires, spec verify); the default
+    set covers the acceptance contracts in ~4 compiles."""
+    from . import programs as P
+    from ..obs.attribution import expected_collectives
+
+    results: List[dict] = []
+
+    # stage 0 rides in the DEFAULT set: its donation contract is the one
+    # that caught the un-pinned out_shardings bug (train_step.py), so the
+    # regression pin must run everywhere the default gate runs
+    train_matrix = [(0, "f32"), (1, "f32"), (2, "int8"), (3, "f32")]
+    if full:
+        train_matrix = [(0, "f32"), (0, "int8"), (1, "f32"), (1, "int8"),
+                        (2, "f32"), (2, "int8"), (3, "f32")]
+    for stage, wire in train_matrix:
+        prog = P.train_step_program(stage, wire)
+        exp = expected_collectives(**prog.config)
+        results.append(check_collective_inventory(prog, exp))
+        results.append(check_donation_aliased(prog))
+        if wire == "int8":
+            allowed = ("all-gather",) if stage >= 1 else ()
+            results.append(check_no_wide_dp_wire(prog,
+                                                 allowed_ops=allowed))
+        if stage == 3:
+            results.append(check_zero3_no_whole_tree_gather(prog))
+
+    # zero-3 must REFUSE a compressed wire, loudly, at build time
+    msg = P.train_step_refuses(3, "int8")
+    results.append(_result(
+        "zero3-int8-refusal", msg is not None and "stage 2" in msg,
+        msg or "zero stage 3 + int8 wire BUILT instead of refusing — "
+               "the compressed wire silently does not apply",
+        "train_step_zero3_int8"))
+
+    # serving: paged decode donation + inventory-free checks
+    dec = P.paged_decode_program()
+    results.append(check_donation_aliased(dec))
+    exp = expected_collectives(**dec.config)
+    results.append(check_collective_inventory(dec, exp))
+
+    # recompile probe: decode step lowered from different host states
+    results.append(check_stable_lowering(
+        "paged_decode", _decode_lowerings()))
+
+    if full:
+        chunk = P.prefill_chunk_program()
+        results.append(check_donation_aliased(chunk))
+        results.append(check_collective_inventory(
+            chunk, expected_collectives(**chunk.config)))
+        ver = P.speculative_verify_program()
+        results.append(check_donation_aliased(ver))
+        results.append(check_collective_inventory(
+            ver, expected_collectives(**ver.config)))
+    return results
+
+
+def _decode_lowerings() -> List[str]:
+    """The paged decode step lowered from 3 different host states (step
+    index, cursor positions, table contents) — shapes identical."""
+    import jax.numpy as jnp
+
+    from . import programs as P
+    eng = P._paged_engine(2)
+    texts = []
+    for bump in (0, 1, 3):
+        tokens = jnp.asarray(eng._tokens) + bump
+        pos = jnp.asarray(eng._pos) + bump
+        tbl = jnp.asarray(eng._tbl)
+        if bump:
+            tbl = tbl.at[0, 0].set(bump % eng.pool.num_pages)
+        lo = eng._step_fn.lower(eng._params_in, eng.pool.ks, eng.pool.vs,
+                                tokens, pos, jnp.asarray(eng._seeds), tbl)
+        texts.append(lo.as_text())
+    return texts

@@ -1,0 +1,331 @@
+"""Source-discipline lints: compat-API bypass, dead imports, unreachable
+statements, and host-thread lock discipline (graftcheck layer 1).
+
+Stdlib-only — see `rules.py`. The compat-bypass rule reads the shimmed
+surface out of `runtime/compat.py`'s own source (an AST literal-eval of its
+`SHIMMED_SURFACE` assignment), so the shim module stays the single owner of
+that list without this module ever importing jax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Set
+
+from .rules import SourceFile, Violation, rule
+
+PACKAGE = "distributed_pytorch_from_scratch_tpu"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`jax.lax.psum` -> "jax.lax.psum" for Name/Attribute chains."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ------------------------------------------------------------ compat-bypass --
+
+_FALLBACK_SURFACE = ("jax.shard_map", "jax.typeof", "jax.lax.axis_size",
+                     "jax.lax.pvary")
+_surface_cache: Optional[tuple] = None
+
+
+def shimmed_surface() -> tuple:
+    """The dotted names `runtime/compat.py` shims, read from its
+    `SHIMMED_SURFACE` literal by AST (no import, no jax). Falls back to the
+    names known at this rule's writing if the assignment ever goes missing
+    — the lint degrading is better than the lint crashing."""
+    global _surface_cache
+    if _surface_cache is not None:
+        return _surface_cache
+    compat = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "runtime", "compat.py")
+    surface = _FALLBACK_SURFACE
+    try:
+        tree = ast.parse(open(compat, encoding="utf-8").read())
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "SHIMMED_SURFACE"
+                            for t in node.targets)):
+                surface = tuple(ast.literal_eval(node.value))
+    except (OSError, SyntaxError, ValueError):
+        pass
+    _surface_cache = surface
+    return surface
+
+
+@rule("compat-bypass",
+      "raw jax API use that bypasses the runtime/compat.py shim layer",
+      "the 0.4.x image breakage PR 2's compat shims fixed: direct "
+      "jax.experimental.shard_map imports and shimmed-surface calls from "
+      "modules that never load the shim break on old-jax images")
+def check_compat_bypass(src: SourceFile) -> List[Violation]:
+    if src.path.replace(os.sep, "/").endswith("runtime/compat.py"):
+        return []
+    out: List[Violation] = []
+    imports_package = False
+    imports_jax = False
+    for node in src.nodes:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] == PACKAGE:
+                    imports_package = True
+                if a.name.split(".")[0] == "jax":
+                    imports_jax = True
+                if a.name.startswith("jax.experimental.shard_map"):
+                    out.append(Violation(
+                        "compat-bypass", src.path, node.lineno,
+                        "import of jax.experimental.shard_map bypasses "
+                        "runtime/compat.py — use jax.shard_map (the shim "
+                        "guarantees it exists and defaults check_rep off "
+                        "on legacy jax)"))
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.split(".")[0] == PACKAGE or node.level:
+                imports_package = True
+            if mod.startswith("jax.experimental.shard_map") or (
+                    mod == "jax.experimental"
+                    and any(a.name == "shard_map" for a in node.names)):
+                out.append(Violation(
+                    "compat-bypass", src.path, node.lineno,
+                    "import of jax.experimental.shard_map bypasses "
+                    "runtime/compat.py — use jax.shard_map"))
+    # shimmed-surface attribute uses are only safe when the compat shim is
+    # guaranteed loaded first: package modules get it from the package
+    # __init__; anything else must import the package (or the shim) itself
+    if src.in_package or imports_package or not imports_jax:
+        return out
+    surface = set(shimmed_surface())
+    for node in src.nodes:
+        name = dotted(node) if isinstance(node, ast.Attribute) else None
+        if name in surface:
+            out.append(Violation(
+                "compat-bypass", src.path, node.lineno,
+                f"{name} is shimmed by runtime/compat.py but this module "
+                f"never loads the shim (import the package, or "
+                f"runtime.compat, before first jax use) — on a 0.4.x "
+                f"image this call does not exist"))
+    return out
+
+
+# ------------------------------------------------------------ unused-import --
+
+@rule("unused-import",
+      "imported name never referenced in the module",
+      "dead imports accumulated across PR 1-10 sweeps; each one is a "
+      "startup cost and a false dependency edge the next refactor trips on")
+def check_unused_import(src: SourceFile) -> List[Violation]:
+    if os.path.basename(src.path) == "__init__.py":
+        return []        # __init__ imports are the re-export surface
+    imported: dict = {}  # local name -> (lineno, display)
+    # honour the ecosystem convention for side-effect imports: a line
+    # carrying `# noqa` (bare, or naming F401) is deliberate
+    noqa_lines = set()
+    for i, line in enumerate(src.text.splitlines(), 1):
+        if "# noqa" in line:
+            tail = line.split("# noqa", 1)[1]
+            if not tail.strip().startswith(":") or "F401" in tail:
+                noqa_lines.add(i)
+    in_try: Set[int] = set()
+    for node in src.nodes:
+        if isinstance(node, ast.Try):
+            for sub in ast.walk(node):
+                in_try.add(id(sub))
+    for node in src.nodes:
+        if id(node) in in_try:
+            continue     # compat-style gated imports are deliberate
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                imported[local] = (node.lineno, a.name)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                imported[local] = (node.lineno, a.name)
+    if not imported:
+        return []
+    used: Set[str] = set()
+    for node in src.nodes:
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            d = dotted(node)
+            if d:
+                used.add(d.split(".")[0])
+    # names in __all__ are exports, not uses-in-module, but keep them
+    for node in src.nodes:
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets)):
+            try:
+                used |= set(ast.literal_eval(node.value))
+            except ValueError:
+                pass
+    # string annotations ("Model") reference names invisibly to the walk
+    for node in src.nodes:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            used |= {w for w in imported
+                     if w in node.value and len(w) > 2}
+    out = []
+    for local, (lineno, display) in sorted(imported.items(),
+                                           key=lambda kv: kv[1][0]):
+        if lineno in noqa_lines:
+            continue
+        if local not in used and not local.startswith("_"):
+            out.append(Violation(
+                "unused-import", src.path, lineno,
+                f"'{display}' imported but never used"))
+    return out
+
+
+# ---------------------------------------------------------- unreachable-code --
+
+_TERMINAL = (ast.Return, ast.Raise, ast.Break, ast.Continue)
+
+
+@rule("unreachable-code",
+      "statements after an unconditional return/raise/break/continue",
+      "dead branches left by the PR 5-9 engine refactors: unreachable "
+      "code reads as load-bearing and rots silently")
+def check_unreachable(src: SourceFile) -> List[Violation]:
+    out = []
+    for node in src.nodes:
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if not isinstance(stmts, list):
+                continue
+            for i, stmt in enumerate(stmts[:-1]):
+                if isinstance(stmt, _TERMINAL):
+                    nxt = stmts[i + 1]
+                    out.append(Violation(
+                        "unreachable-code", src.path, nxt.lineno,
+                        f"statement is unreachable (follows "
+                        f"{type(stmt).__name__.lower()} on line "
+                        f"{stmt.lineno})"))
+                    break
+    return out
+
+
+# ---------------------------------------------------------- lock-discipline --
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+_MUTATORS = {"append", "appendleft", "extend", "pop", "popleft", "add",
+             "remove", "discard", "insert", "clear", "update", "setdefault",
+             "popitem"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """self.<attr> -> attr (only depth-1: self.x, not self.x.y)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _stmt_mutations(stmt, holding: bool, sink, lock_attrs):
+    """Collect (attr, lineno, holding_lock) for every `self.<attr>`
+    mutation under `stmt`, tracking `with self.<lock>:` context (only
+    attrs in `lock_attrs` count as locks — `with self._span(...)` is a
+    tracer, not a guard)."""
+    if isinstance(stmt, (ast.Assign, ast.AugAssign)):
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [
+            stmt.target]
+        for t in targets:
+            attr = _self_attr(t)
+            if attr is None and isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+            if attr is None and isinstance(t, ast.Tuple):
+                for el in t.elts:
+                    a = _self_attr(el)
+                    if a:
+                        sink.append((a, stmt.lineno, holding))
+            if attr:
+                sink.append((attr, stmt.lineno, holding))
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr in _MUTATORS:
+            attr = _self_attr(f.value)
+            if attr:
+                sink.append((attr, stmt.lineno, holding))
+    # recurse into compound statements, preserving lock context
+    inner = holding
+    if isinstance(stmt, ast.With):
+        for item in stmt.items:
+            ctx = item.context_expr
+            if _self_attr(ctx) in lock_attrs:
+                inner = True
+    for field in ("body", "orelse", "finalbody", "handlers"):
+        sub = getattr(stmt, field, None)
+        if isinstance(sub, list):
+            for s in sub:
+                if isinstance(s, ast.ExceptHandler):
+                    for ss in s.body:
+                        _stmt_mutations(ss, inner, sink, lock_attrs)
+                else:
+                    _stmt_mutations(s, inner, sink, lock_attrs)
+
+
+@rule("lock-discipline",
+      "attribute guarded by the class lock mutated without holding it",
+      "the obs/flight + prefetch + ckpt-writer host threads share state "
+      "with the main loop; an unlocked mutation is a torn dump / lost "
+      "heartbeat under exactly the anomaly the recorder exists to capture")
+def check_lock_discipline(src: SourceFile) -> List[Violation]:
+    out = []
+    for cls in src.nodes:
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        # does this class own a lock? (self._lock = threading.Lock() ...)
+        lock_attrs = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                ctor = dotted(node.value.func)
+                if ctor in _LOCK_CTORS:
+                    for t in node.targets:
+                        a = _self_attr(t)
+                        if a:
+                            lock_attrs.add(a)
+        if not lock_attrs:
+            continue
+        # first pass: which attrs are EVER mutated under the lock
+        per_method: dict = {}
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            sink: list = []
+            for stmt in fn.body:
+                _stmt_mutations(stmt, False, sink, lock_attrs)
+            per_method[fn.name] = sink
+        guarded = {attr for sink in per_method.values()
+                   for attr, _, locked in sink if locked}
+        guarded -= lock_attrs
+        if not guarded:
+            continue
+        # second pass: mutations of guarded attrs with the lock NOT held
+        for name, sink in per_method.items():
+            if name == "__init__":
+                continue   # construction precedes sharing
+            for attr, lineno, locked in sink:
+                if attr in guarded and not locked:
+                    out.append(Violation(
+                        "lock-discipline", src.path, lineno,
+                        f"self.{attr} is mutated under the class lock "
+                        f"elsewhere but written here without holding it "
+                        f"({cls.name}.{name}) — a host thread racing this "
+                        f"write tears the shared state"))
+    return out

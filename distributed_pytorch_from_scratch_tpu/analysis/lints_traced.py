@@ -1,0 +1,408 @@
+"""Trace-hygiene lints: host calls inside traced code, use-after-donate,
+and PRNG key reuse (graftcheck layer 1).
+
+Stdlib-only — see `rules.py`. "Traced" is decided structurally, never by
+running jax: a function is traced when it is (a) decorated with a jit/
+shard_map/checkpoint-family decorator, (b) passed by name into a trace
+entrypoint (`jax.jit(f, ...)`, `jax.lax.scan(body, ...)`, ...), or (c)
+defined inside a traced function. Host-side effects inside such a function
+run at TRACE time, not step time — `time.time()` timestamps the compile,
+`np.random` freezes one draw into the program, `device_get` forces a sync
+that defeats async dispatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .lints_source import dotted
+from .rules import SourceFile, Violation, rule
+
+# callables whose function-valued arguments become traced code
+_TRACE_ENTRYPOINTS = {
+    "jax.jit", "jit", "jax.pmap", "jax.shard_map", "shard_map",
+    "jax.checkpoint", "jax.remat", "checkpoint", "remat",
+    "jax.vmap", "jax.grad", "jax.value_and_grad", "jax.jacrev",
+    "jax.jacfwd", "jax.linearize", "jax.vjp", "jax.jvp",
+    "jax.eval_shape", "jax.make_jaxpr",
+    "jax.lax.scan", "jax.lax.while_loop", "jax.lax.fori_loop",
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.custom_vjp", "jax.custom_jvp",
+    "lax.scan", "lax.while_loop", "lax.fori_loop", "lax.cond",
+    "lax.switch", "lax.map",
+}
+
+_TRACE_DECORATORS = {
+    "jax.jit", "jit", "jax.shard_map", "shard_map", "jax.checkpoint",
+    "jax.remat", "jax.vmap", "jax.custom_vjp", "jax.custom_jvp",
+    "jax.pmap",
+}
+
+
+def _called_name(call: ast.Call) -> Optional[str]:
+    name = dotted(call.func)
+    if name is not None:
+        return name
+    # functools.partial(jax.jit, ...) used as a decorator/entrypoint
+    if isinstance(call.func, ast.Call):
+        inner = dotted(call.func.func)
+        if inner in ("functools.partial", "partial"):
+            if call.func.args:
+                return dotted(call.func.args[0])
+    return None
+
+
+def _traced_function_nodes(tree: ast.AST) -> List[ast.AST]:
+    """FunctionDef/Lambda nodes whose bodies are traced (see module doc)."""
+    traced_names: Set[str] = set()
+    inline_fns: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _called_name(node)
+        if name is None:
+            continue
+        target = name
+        if isinstance(node.func, ast.Call):
+            inner = dotted(node.func.func)
+            if inner in ("functools.partial", "partial") and node.func.args:
+                target = dotted(node.func.args[0]) or name
+        if target not in _TRACE_ENTRYPOINTS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name):
+                traced_names.add(arg.id)
+            elif isinstance(arg, ast.Lambda):
+                inline_fns.append(arg)
+    out: List[ast.AST] = list(inline_fns)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in traced_names:
+            out.append(node)
+            continue
+        for deco in node.decorator_list:
+            dname = None
+            if isinstance(deco, ast.Call):
+                dname = _called_name(deco)
+            else:
+                dname = dotted(deco)
+            if dname in _TRACE_DECORATORS:
+                out.append(node)
+                break
+    return out
+
+
+def _module_imports(tree: ast.AST) -> Set[str]:
+    """Top-level module names imported (un-aliased root names)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                out.add(a.asname or a.name)
+    return out
+
+
+# one checker walks the traced bodies once and emits all three rule ids
+
+@rule("host-sync-in-traced",
+      "device_get / block_until_ready / .item() inside traced code",
+      "the obs round-3 'lse timing' lie: a block_until_ready inside the "
+      "jitted wrapper made the kernel look synchronous and the timing "
+      "honest-looking but wrong (fixed by scripts/tpu_checks.py's shared "
+      "jit wrapper, PR 3)")
+@rule("host-time-in-traced",
+      "time.* / datetime.now inside traced code",
+      "a time.time() inside a jitted body stamps TRACE time into the "
+      "program as a constant — the per-step 'timing' never changes again")
+@rule("host-rng-in-traced",
+      "numpy/stdlib RNG inside traced code",
+      "np.random inside a traced function freezes ONE host draw into the "
+      "compiled program: every step reuses it, silently — the class of "
+      "bug the per-request fold_in schedule (PR 5/7) exists to prevent")
+def check_host_calls_in_traced(src: SourceFile) -> List[Violation]:
+    imports = _module_imports(src.tree)
+    out: List[Violation] = []
+    seen: Set[int] = set()
+    for fn in _traced_function_nodes(src.tree):
+        for node in ast.walk(fn):
+            if id(node) in seen or not isinstance(node, ast.Call):
+                continue
+            seen.add(id(node))
+            name = dotted(node.func) or ""
+            if name in ("jax.device_get", "jax.block_until_ready"):
+                out.append(Violation(
+                    "host-sync-in-traced", src.path, node.lineno,
+                    f"{name} inside traced code forces a host sync at "
+                    f"trace time (and fails on tracers at step time)"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in ("block_until_ready", "item")):
+                out.append(Violation(
+                    "host-sync-in-traced", src.path, node.lineno,
+                    f".{node.func.attr}() inside traced code — tracers "
+                    f"have no device buffer to sync; this is host logic "
+                    f"leaking into the program"))
+            elif (name.startswith("time.") and "time" in imports) or \
+                    name in ("datetime.now", "datetime.datetime.now"):
+                out.append(Violation(
+                    "host-time-in-traced", src.path, node.lineno,
+                    f"{name}() inside traced code runs at TRACE time — "
+                    f"the value is baked into the program as a constant"))
+            elif ((name.startswith("np.random.")
+                   or name.startswith("numpy.random."))
+                  or (name.startswith("random.") and "random" in imports)):
+                out.append(Violation(
+                    "host-rng-in-traced", src.path, node.lineno,
+                    f"{name}() is host RNG inside traced code — one draw "
+                    f"is frozen into the compiled program; thread a "
+                    f"jax.random key (fold_in per step) instead"))
+    return out
+
+
+# --------------------------------------------------------- use-after-donate --
+
+def _donating_assigns(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    """name (possibly dotted, e.g. 'self._step_fn') -> donate_argnums for
+    assignments of the form `name = jax.jit(f, donate_argnums=...)` (the
+    argnums must be a literal int/tuple to be tracked)."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.Return)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        if dotted(value.func) not in ("jax.jit", "jit"):
+            continue
+        argnums = None
+        for kw in value.keywords:
+            if kw.arg == "donate_argnums":
+                try:
+                    lit = ast.literal_eval(kw.value)
+                except ValueError:
+                    lit = None
+                if isinstance(lit, int):
+                    argnums = (lit,)
+                elif isinstance(lit, (tuple, list)):
+                    argnums = tuple(int(i) for i in lit)
+        if argnums is None:
+            continue
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                name = dotted(t)
+                if name:
+                    out[name] = argnums
+    return out
+
+
+def _scan_donation_scope(stmts, donating, out, src, dead=None):
+    """Linear statement walk: after `f(a, b)` where f donates argnum i,
+    a Load of the donated name before its next Store is a use-after-donate.
+    Loop bodies are walked twice so a donation in iteration N flags the
+    un-rebound read in iteration N+1."""
+    dead = dead if dead is not None else {}
+
+    def names_loaded(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                yield sub.id, sub.lineno
+            elif isinstance(sub, ast.Attribute) and isinstance(
+                    sub.ctx, ast.Load):
+                d = dotted(sub)
+                if d:
+                    yield d, sub.lineno
+
+    def names_stored(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                    sub.ctx, ast.Store):
+                d = sub.id if isinstance(sub, ast.Name) else dotted(sub)
+                if d:
+                    yield d
+
+    for stmt in stmts:
+        # within one statement the order of effects is: argument reads,
+        # then the donating call, then the statement's own stores — so
+        # `params, opt, _ = step(params, opt, ...)` donates AND rebinds
+        for name, lineno in names_loaded(stmt):
+            if name in dead:
+                don_line, fn_name = dead[name]
+                out.append(Violation(
+                    "use-after-donate", src.path, lineno,
+                    f"'{name}' was donated to {fn_name}() on line "
+                    f"{don_line} (donate_argnums) — its buffer is dead; "
+                    f"reading it returns garbage on hardware that honours "
+                    f"donation"))
+                # report once per donation
+                del dead[name]
+        for sub in ast.walk(stmt):
+            if not isinstance(sub, ast.Call):
+                continue
+            fname = dotted(sub.func)
+            if fname in donating:
+                for i in donating[fname]:
+                    if i < len(sub.args):
+                        arg = sub.args[i]
+                        aname = dotted(arg)
+                        if aname:
+                            dead[aname] = (sub.lineno, fname)
+        for name in names_stored(stmt):
+            dead.pop(name, None)
+        # recurse into compound statements in order; loops twice
+        for field in ("body", "orelse", "finalbody"):
+            sub_stmts = getattr(stmt, field, None)
+            if isinstance(sub_stmts, list) and sub_stmts:
+                reps = 2 if isinstance(stmt, (ast.For, ast.While)) \
+                    and field == "body" else 1
+                for _ in range(reps):
+                    _scan_donation_scope(sub_stmts, donating, out, src,
+                                         dead)
+
+
+@rule("use-after-donate",
+      "argument read after being passed to a donate_argnums program",
+      "the PR 3 bench bug: run_breakdown computed FLOPs from params AFTER "
+      "donating them to the step — garbage math on chip, invisible on CPU "
+      "where donation is a no-op")
+def check_use_after_donate(src: SourceFile) -> List[Violation]:
+    donating = _donating_assigns(src.tree)
+    if not donating:
+        return []
+    out: List[Violation] = []
+    # module level + each function scope, statements in order
+    _scan_donation_scope(src.tree.body, donating, out, src)
+    for node in src.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_donation_scope(node.body, donating, out, src)
+    # de-duplicate (module walk visits nested defs' statements too)
+    uniq = {(v.line, v.message): v for v in out}
+    return list(uniq.values())
+
+
+# ----------------------------------------------------------- prng-key-reuse --
+
+_KEY_SOURCES = {"jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+                "jax.random.fold_in", "jax.random.wrap_key_data",
+                "jax.random.clone", "random.PRNGKey", "random.split",
+                "random.fold_in"}
+_NON_CONSUMING = {"split", "fold_in", "key_data", "wrap_key_data", "clone",
+                  "key_impl", "PRNGKey", "key"}
+
+
+def _is_key_source(call: ast.Call) -> bool:
+    return dotted(call.func) in _KEY_SOURCES
+
+
+def _consumer_name(call: ast.Call) -> Optional[str]:
+    """jax.random.<fn> consuming its key argument -> <fn>, else None."""
+    name = dotted(call.func) or ""
+    if not name.startswith(("jax.random.", "jrandom.", "jr.")):
+        return None
+    fn = name.rsplit(".", 1)[1]
+    if fn in _NON_CONSUMING:
+        return None
+    return fn
+
+
+@rule("prng-key-reuse",
+      "a PRNG key consumed twice without split/fold_in between",
+      "two draws from one key are IDENTICAL draws: the correlated-sampling "
+      "bug class the per-request (seed, position, stream) fold_in schedule "
+      "in serving/ was built to rule out")
+def check_prng_key_reuse(src: SourceFile) -> List[Violation]:
+    out: List[Violation] = []
+    _FN = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+    def shallow_exprs(stmt):
+        """Expression nodes the statement itself evaluates (not nested
+        statement lists, not nested function bodies)."""
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            yield stmt.iter
+        elif isinstance(stmt, (ast.While, ast.If)):
+            yield stmt.test
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                yield item.context_expr
+        elif isinstance(stmt, _FN + (ast.ClassDef, ast.Try)):
+            return
+        else:
+            yield stmt
+
+    def scan_scope(fn_node):
+        keys: Dict[str, int] = {}          # name -> consumption count
+        born_line: Dict[str, int] = {}
+        loop_depth_of: Dict[str, int] = {}
+
+        def handle_expr(node, loop_depth):
+            for sub in ast.walk(node):
+                if isinstance(sub, _FN):
+                    continue  # nested scopes are scanned separately
+                if isinstance(sub, ast.Assign) and isinstance(
+                        sub.value, ast.Call) and _is_key_source(sub.value):
+                    for t in sub.targets:
+                        targets = t.elts if isinstance(t, ast.Tuple) \
+                            else [t]
+                        for el in targets:
+                            if isinstance(el, ast.Name):
+                                keys[el.id] = 0
+                                born_line[el.id] = sub.lineno
+                                loop_depth_of[el.id] = loop_depth
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:  # any other rebind forgets it
+                        targets = t.elts if isinstance(t, ast.Tuple) \
+                            else [t]
+                        for el in targets:
+                            if isinstance(el, ast.Name):
+                                keys.pop(el.id, None)
+                if isinstance(sub, ast.Call):
+                    fn = _consumer_name(sub)
+                    if fn and sub.args and isinstance(sub.args[0],
+                                                      ast.Name):
+                        kname = sub.args[0].id
+                        if kname not in keys:
+                            continue
+                        keys[kname] += 1
+                        if keys[kname] == 2:
+                            out.append(Violation(
+                                "prng-key-reuse", src.path, sub.lineno,
+                                f"key '{kname}' (from line "
+                                f"{born_line[kname]}) consumed a second "
+                                f"time by jax.random.{fn} — identical "
+                                f"randomness; split or fold_in first"))
+                        elif (keys[kname] == 1 and loop_depth
+                                > loop_depth_of.get(kname, loop_depth)):
+                            keys[kname] += 1  # report once
+                            out.append(Violation(
+                                "prng-key-reuse", src.path, sub.lineno,
+                                f"key '{kname}' defined outside this "
+                                f"loop is consumed by jax.random.{fn} "
+                                f"every iteration without fold_in — "
+                                f"every iteration draws the SAME "
+                                f"randomness"))
+
+        def walk(stmts, loop_depth):
+            for stmt in stmts:
+                for expr in shallow_exprs(stmt):
+                    handle_expr(expr, loop_depth)
+                deeper = loop_depth + (
+                    1 if isinstance(stmt, (ast.For, ast.AsyncFor,
+                                           ast.While)) else 0)
+                for field in ("body", "orelse", "finalbody"):
+                    subs = getattr(stmt, field, None)
+                    if isinstance(subs, list) and not isinstance(
+                            stmt, _FN + (ast.ClassDef,)):
+                        walk(subs, deeper if field == "body"
+                             else loop_depth)
+                for h in getattr(stmt, "handlers", []):
+                    walk(h.body, loop_depth)
+
+        walk(fn_node.body, 0)
+
+    for node in src.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_scope(node)
+    return out

@@ -1,0 +1,240 @@
+"""Canonical-program builders for the graftcheck trace contracts (layer 2).
+
+Each builder lowers + compiles ONE of the programs whose collective
+schedule `obs/attribution.py` prices, on the virtual-CPU test mesh, at a
+tiny model shape (the schedule depends on parallelism topology, not
+parameter count). The result is a `Program` record carrying both text
+forms plus the donation bookkeeping `contracts.py` asserts over:
+
+* train step at zero ∈ {0,1,2,3} × wire ∈ {f32, int8} on dp2 x tp2 + SP
+  (the ZeRO ladder's canonical mesh, tests/test_zero.py's shape);
+* the paged decode step, a prefill chunk, and the speculative K+1 verify
+  dispatch on tp2 (serving's canonical programs, engine-built so the
+  contract covers what production actually compiles).
+
+jax is imported lazily: importing this module costs nothing, and layer 1
+never triggers it. Builders are cached — the CLI and several contracts
+share one compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class Program:
+    name: str
+    lowered_text: str
+    compiled_text: str
+    mesh: object                      # jax Mesh (axis classification)
+    donated_leaves: int               # leaves of the donated argnums
+    donated_flat_start: int           # first flat input index donated
+    donated_flat_stop: int            # one past the last
+    config: Dict                      # kwargs for expected_collectives
+
+
+def _tiny_model_cfg(maxlen: int = 32):
+    from ..config import ModelConfig
+    return ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                       vocab_size=96, maxlen=maxlen)
+
+
+def _batch(key, batch=8, t=16, vocab=96):
+    import jax
+    import jax.numpy as jnp
+    k1, k2 = jax.random.split(key)
+    ids = jax.random.randint(k1, (batch, t), 0, vocab)
+    tgt = jax.random.randint(k2, (batch, t), 0, vocab)
+    pos = jnp.tile(jnp.arange(t)[None, :], (batch, 1))
+    return ids, tgt, pos
+
+
+def _donation_span(args, donate_argnums) -> Tuple[int, int, int]:
+    """(leaves, flat_start, flat_stop) for contiguous donated argnums —
+    the flat input indices the compiled input_output_alias map refers to."""
+    import jax
+    donate = sorted(donate_argnums)
+    assert donate == list(range(donate[0], donate[-1] + 1)), donate
+    start = sum(len(jax.tree.leaves(args[i])) for i in range(donate[0]))
+    n = sum(len(jax.tree.leaves(args[i])) for i in donate)
+    return n, start, start + n
+
+
+@functools.lru_cache(maxsize=16)
+def train_step_program(zero_stage: int = 1, wire: str = "f32",
+                       dp: int = 2, tp: int = 2) -> Program:
+    """Lower+compile one train step at the given ZeRO stage and DP wire
+    dtype on a dp x tp + SP mesh. wire='int8' implies the bucketed reducer
+    (the stage-0/1/2 int8 path; stage 3 REFUSES int8 — callers assert that
+    refusal separately via `train_step_refuses`)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..config import MeshConfig, OptimizerConfig
+    from ..models.transformer import Transformer
+    from ..runtime.mesh import make_mesh
+    from ..training.optim import AdamState, init_adam_state
+    from ..training.train_step import build_train_step
+    from ..training.zero import zero1_moment_shardings, zero3_shardings
+
+    cfg = _tiny_model_cfg()
+    ocfg = OptimizerConfig(lr=1e-3, warmup_steps=5, max_steps=50)
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    model = Transformer(cfg, tp_size=tp, sequence_parallel=(tp > 1),
+                        remat="dots")
+    kw: Dict = dict(zero=zero_stage)
+    bucketed = wire == "int8" or zero_stage >= 2
+    if wire == "int8":
+        kw.update(dp_reduce_bucket_mb=25.0, dp_reduce_dtype=jnp.int8)
+    elif zero_stage >= 2:
+        kw.update(dp_reduce_bucket_mb=25.0)
+    step = build_train_step(model, mesh, ocfg, **kw)
+
+    if zero_stage >= 3:
+        param_sh = zero3_shardings(model, mesh)
+        moment_sh = param_sh
+    else:
+        param_sh = model.shardings(mesh)
+        moment_sh = (zero1_moment_shardings(model, mesh)
+                     if zero_stage >= 1 else param_sh)
+    params = jax.device_put(model.init(jax.random.key(0)), param_sh)
+    scalar = NamedSharding(mesh, P())
+    opt = jax.device_put(init_adam_state(params),
+                         AdamState(step=scalar, mu=moment_sh, nu=moment_sh))
+    ids, tgt, pos = _batch(jax.random.key(1), vocab=cfg.vocab_size)
+    args = (params, opt, ids, tgt, pos)
+    lowered = step.lower(*args)
+    compiled = lowered.compile()
+    leaves, start, stop = _donation_span(args, (0, 1))
+    return Program(
+        name=f"train_step_zero{zero_stage}_{wire}",
+        lowered_text=lowered.as_text(),
+        compiled_text=compiled.as_text(),
+        mesh=mesh,
+        donated_leaves=leaves,
+        donated_flat_start=start,
+        donated_flat_stop=stop,
+        config=dict(tp=tp, sp=tp > 1, tp_overlap="off", dp=dp,
+                    dp_bucket_mb=25.0 if bucketed else 0.0,
+                    dp_reduce_dtype=wire if wire != "f32" else "f32",
+                    zero_stage=zero_stage))
+
+
+def train_step_refuses(zero_stage: int, wire: str,
+                       dp: int = 2, tp: int = 2) -> Optional[str]:
+    """The error message a refused (stage, wire) combination raises at
+    build time, or None if the build is accepted. The loud-refusal
+    contract: zero-3 + int8 must refuse (the compressed wire would
+    silently not apply), never fall back."""
+    try:
+        train_step_program(zero_stage, wire, dp, tp)
+    except ValueError as e:
+        # lru_cache never caches a raising call, so the refusal is
+        # re-evaluated (and re-raised) on every probe — nothing to evict
+        return str(e)
+    return None
+
+
+@functools.lru_cache(maxsize=4)
+def _paged_engine(tp: int = 2, speculative: bool = False):
+    import jax
+
+    from ..config import MeshConfig
+    from ..models.transformer import Transformer
+    from ..runtime.mesh import make_mesh
+    from ..serving.engine import PagedEngine
+
+    cfg = _tiny_model_cfg(maxlen=64)
+    mesh = make_mesh(MeshConfig(dp=1, tp=tp))
+    model = Transformer(cfg, tp_size=tp)
+    params = jax.device_put(model.init(jax.random.key(7)),
+                            model.shardings(mesh))
+    if speculative:
+        from ..serving.speculative import SpeculativeEngine
+        dmodel = Transformer(cfg, tp_size=tp)
+        dparams = jax.device_put(dmodel.init(jax.random.key(9)),
+                                 dmodel.shardings(mesh))
+        return SpeculativeEngine(model, mesh, params, dmodel, dparams,
+                                 num_slots=2, buf_len=32, eos_id=1,
+                                 speculate_k=2, page_size=8,
+                                 prefill_chunk=4)
+    return PagedEngine(model, mesh, params, num_slots=2, buf_len=32,
+                       eos_id=1, page_size=8, prefill_chunk=4)
+
+
+def _engine_step_args(eng):
+    import jax.numpy as jnp
+    return (eng._params_in, eng.pool.ks, eng.pool.vs,
+            jnp.asarray(eng._tokens), jnp.asarray(eng._pos),
+            jnp.asarray(eng._seeds), jnp.asarray(eng._tbl))
+
+
+def _finish(name, eng, fn, args, donate_argnums, config) -> Program:
+    lowered = fn.lower(*args)
+    compiled = lowered.compile()
+    leaves, start, stop = _donation_span(args, donate_argnums)
+    return Program(name=name, lowered_text=lowered.as_text(),
+                   compiled_text=compiled.as_text(), mesh=eng.mesh,
+                   donated_leaves=leaves, donated_flat_start=start,
+                   donated_flat_stop=stop, config=config)
+
+
+@functools.lru_cache(maxsize=4)
+def paged_decode_program(tp: int = 2) -> Program:
+    """The paged decode step exactly as PagedEngine compiles it (donated
+    KV pool halves, per-row cursors over the page table)."""
+    eng = _paged_engine(tp)
+    cfg = dict(serving=True, tp=tp, dp=1, kind="decode")
+    return _finish(f"paged_decode_tp{tp}", eng, eng._step_fn,
+                   _engine_step_args(eng), (1, 2), cfg)
+
+
+@functools.lru_cache(maxsize=4)
+def prefill_chunk_program(tp: int = 2, cw: int = 4) -> Program:
+    """One chunked-prefill dispatch (width cw) from the paged engine."""
+    import jax.numpy as jnp
+    eng = _paged_engine(tp)
+    fn = eng._build_chunk(cw)
+    n = eng.num_slots
+    args = (eng._params_in, eng.pool.ks, eng.pool.vs,
+            jnp.zeros((n, cw), jnp.int32), jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32), jnp.asarray(eng._tbl),
+            jnp.zeros((n, cw), jnp.int32), jnp.zeros((n, cw), jnp.int32),
+            jnp.asarray(eng._seeds))
+    cfg = dict(serving=True, tp=tp, dp=1, kind="prefill_chunk")
+    return _finish(f"prefill_chunk_tp{tp}_w{cw}", eng, fn, args, (1, 2),
+                   cfg)
+
+
+@functools.lru_cache(maxsize=4)
+def speculative_verify_program(tp: int = 2, k: int = 2) -> Program:
+    """The speculative engine's K+1 verify dispatch (target scores k+1
+    positions through the page table in one program)."""
+    import jax.numpy as jnp
+    eng = _paged_engine(tp, speculative=True)
+    fn = eng._verify_fn
+    n = eng.num_slots
+    w = k + 1
+    # greedy verify signature (speculative.py's round loop): params, pool
+    # halves, pending tokens, the k drafts, cursors, window lengths, page
+    # table, per-position dest page/offset, seeds
+    args = (eng._params_in, eng.pool.ks, eng.pool.vs,
+            jnp.zeros((n,), jnp.int32),             # pending token
+            jnp.zeros((n, k), jnp.int32),           # drafted tokens
+            jnp.zeros((n,), jnp.int32),             # pos
+            jnp.ones((n,), jnp.int32),              # qlen
+            jnp.asarray(eng._tbl),
+            jnp.zeros((n, w), jnp.int32), jnp.zeros((n, w), jnp.int32),
+            jnp.asarray(eng._seeds))
+    cfg = dict(serving=True, tp=tp, dp=1, kind="spec_verify")
+    return _finish(f"spec_verify_tp{tp}_k{k}", eng, fn, args, (1, 2), cfg)
+
+
+def clear_caches() -> None:
+    for fn in (train_step_program, _paged_engine, paged_decode_program,
+               prefill_chunk_program, speculative_verify_program):
+        fn.cache_clear()

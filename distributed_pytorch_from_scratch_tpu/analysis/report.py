@@ -1,0 +1,103 @@
+"""graftcheck report: one versioned JSON document + the human rendering.
+
+Follows obs/schema.py's discipline: a `schema_version` stamp, a required-
+field contract consumers can key on, and validation that fails loudly
+instead of silently dropping sections. `scripts/summarize_run.py` renders a
+"graftcheck" section from this document when one is present in a run dir.
+Stdlib-only (see rules.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional
+
+from .rules import GRAFTCHECK_SCHEMA_VERSION, RULES, Violation
+
+#: fields a consumer may key on (presence contract, obs/schema.py style)
+REPORT_REQUIRED = ("schema_version", "tool", "ok", "violations",
+                   "files_scanned", "rules", "contracts")
+
+
+def build_report(violations: List[Violation], files_scanned: int,
+                 contracts: Optional[List[dict]] = None,
+                 duration_s: Optional[float] = None) -> dict:
+    """The versioned JSON document. `contracts` is layer 2's result list
+    (each: {name, ok, detail, program?}); None means the trace layer was
+    skipped (--no-trace), which is recorded distinctly from "ran clean"."""
+    contracts = contracts if contracts is not None else []
+    failed = [c for c in contracts if not c.get("ok")]
+    return {
+        "schema_version": GRAFTCHECK_SCHEMA_VERSION,
+        "tool": "graftcheck",
+        "wall_time": time.time(),
+        "duration_s": round(duration_s, 3) if duration_s else None,
+        "ok": not violations and not failed,
+        "files_scanned": files_scanned,
+        "rules": {rid: {"summary": r.summary} for rid, r in RULES.items()},
+        "violations": [v.asdict() for v in violations],
+        "violation_counts": _counts(violations),
+        "contracts": contracts,
+    }
+
+
+def _counts(violations: List[Violation]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for v in violations:
+        out[v.rule] = out.get(v.rule, 0) + 1
+    return out
+
+
+def validate_report(doc: dict) -> List[str]:
+    """Problems with a parsed report (obs/schema.validate_record style):
+    missing required fields, a version newer than this reader."""
+    problems = []
+    if not isinstance(doc, dict):
+        return ["report is not a JSON object"]
+    for field in REPORT_REQUIRED:
+        if field not in doc:
+            problems.append(f"graftcheck report missing field {field!r}")
+    v = doc.get("schema_version")
+    if isinstance(v, int) and v > GRAFTCHECK_SCHEMA_VERSION:
+        problems.append(
+            f"graftcheck report schema_version {v} is NEWER than this "
+            f"reader ({GRAFTCHECK_SCHEMA_VERSION}) — update the consumer")
+    return problems
+
+
+def format_report(doc: dict, verbose: bool = False) -> str:
+    """Human text: violations grouped by rule, then the contract table."""
+    lines = []
+    vios = doc.get("violations", [])
+    contracts = doc.get("contracts", [])
+    for v in vios:
+        lines.append(f"{v['path']}:{v['line']}: [{v['rule']}] "
+                     f"{v['message']}")
+    if vios:
+        by = doc.get("violation_counts", {})
+        lines.append("")
+        lines.append("violations by rule: "
+                     + ", ".join(f"{k} x{n}" for k, n in sorted(by.items())))
+    for c in contracts:
+        mark = "ok " if c.get("ok") else "FAIL"
+        prog = f" [{c['program']}]" if c.get("program") else ""
+        if c.get("ok") and not verbose:
+            lines.append(f"  [{mark}] {c['name']}{prog}")
+        else:
+            lines.append(f"  [{mark}] {c['name']}{prog}: "
+                         f"{c.get('detail', '')}")
+    n_fail = sum(1 for c in contracts if not c.get("ok"))
+    status = "clean" if doc.get("ok") else "VIOLATIONS"
+    lines.append(
+        f"graftcheck: {status} — {len(vios)} lint violation(s) over "
+        f"{doc.get('files_scanned', 0)} file(s), "
+        f"{len(contracts) - n_fail}/{len(contracts)} trace contract(s) ok"
+        + (f" in {doc['duration_s']}s" if doc.get("duration_s") else ""))
+    return "\n".join(lines)
+
+
+def write_report(doc: dict, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")

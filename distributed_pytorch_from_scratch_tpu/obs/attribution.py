@@ -604,6 +604,152 @@ def format_attribution(report: Dict,
     return "\n".join(lines)
 
 
+# -- checkable collective schedule (ISSUE 11) ----------------------------
+
+def expected_collectives(tp: int = 1, sp: bool = False,
+                         tp_overlap: str = "off", dp: int = 1,
+                         dp_bucket_mb: float = 0.0,
+                         dp_reduce_dtype: str = "f32",
+                         zero_stage: int = 0,
+                         serving: bool = False,
+                         kind: Optional[str] = None) -> Dict:
+    """The schedule `comm_attribution` prices, as a CHECKABLE contract
+    over a compiled program's collective inventory: (mesh axis, HLO op)
+    pairs that must be present (`require`), may be present (`allow`), and
+    must NOT be present (`forbid`), each with the wire dtypes the priced
+    schedule carries. `analysis/contracts.check_collective_inventory`
+    asserts a lowered program against this — so when a refactor changes
+    the wire (a new collective, a dtype fallback, a gather that stopped
+    ringing), the contract fails INSTEAD of the attribution silently
+    mispricing it.
+
+    The mapping from priced records to physical ops: monolithic psums are
+    `all-reduce`; SP's boundary collectives are `all-gather` /
+    `reduce-scatter`; every hand-rolled ring (ring/ring_q tp overlap, the
+    quantized DP wire, ZeRO-3's per-layer gathers and their transposes)
+    is `collective-permute`. Axes: 'dp'/'tp' are the mesh axes; 'all' is
+    a reduction spanning the whole mesh (SP-replicated leaf grads, the
+    loss mean); XLA-derived entries (the ZeRO-1/2 param all-gather, the
+    all-to-all it may rewrite SP gathers into) are included and marked —
+    they are part of the stage's schedule even though the pricing
+    attributes them to other records.
+
+    `dp_bucket_mb` is accepted for symmetry with `comm_attribution`'s
+    config surface (program configs pass through verbatim): bucketing
+    changes collective COUNTS and overlap, never the (axis, op)
+    inventory, so it does not alter the sets today.
+    """
+    require: Dict[tuple, dict] = {}
+    allow: Dict[tuple, str] = {}
+    forbid: Dict[tuple, str] = {}
+    wide = {"f32", "bf16", "f16"}
+
+    if tp > 1:
+        if sp:
+            require[("tp", "all-gather")] = {
+                "dtypes": wide,
+                "note": "SP boundary gathers (qkv/ffn/lm_head records)"}
+            require[("tp", "reduce-scatter")] = {
+                "dtypes": wide,
+                "note": "SP boundary scatters (wo/ffn/embed records)"}
+            require[("tp", "all-reduce")] = {
+                "dtypes": wide,
+                "note": "CE scalar-field psums (+ small SP residuals)"}
+            allow[("tp", "all-to-all")] = (
+                "XLA rewrites some SP gather+slice patterns into "
+                "all-to-all; same bytes, priced under the gather records")
+        else:
+            require[("tp", "all-reduce")] = {
+                "dtypes": wide,
+                "note": "monolithic per-sublayer psums (no-SP schedule)"}
+            allow[("tp", "all-gather")] = "XLA-derived activation gathers"
+            allow[("tp", "reduce-scatter")] = "XLA-derived scatters"
+            allow[("tp", "all-to-all")] = "XLA-derived rewrites"
+        if tp_overlap in ("ring", "ring_q"):
+            require[("tp", "collective-permute")] = {
+                "dtypes": ({"s8"} | wide if tp_overlap == "ring_q"
+                           else wide),
+                "note": f"the {tp_overlap} collective-matmul rings"}
+        allow[("all", "all-reduce")] = (
+            "whole-mesh sums: the loss mean and SP-replicated leaf grads "
+            "(dp x tp groups)")
+
+    if dp > 1 and not serving:
+        int8 = dp_reduce_dtype in ("int8", "s8")
+        if zero_stage >= 3:
+            require[("dp", "collective-permute")] = {
+                "dtypes": {"f32"},
+                "note": "ZeRO-3 per-layer gather rings + their "
+                        "reduce-scatter transposes (f32 by contract)"}
+            forbid[("dp", "all-gather")] = (
+                "a dp all-gather in a ZeRO-3 program is the whole-tree "
+                "param materialisation the stage exists to eliminate")
+            allow[("dp", "all-reduce")] = (
+                "residual psums for leaves too small to shard")
+        elif zero_stage == 2:
+            if int8:
+                require[("dp", "collective-permute")] = {
+                    "dtypes": {"s8"},
+                    "note": "quantized reduce-scatter ring (int8 codes; "
+                            "f32 group scales ride below the sidecar "
+                            "threshold)"}
+            else:
+                require[("dp", "reduce-scatter")] = {
+                    "dtypes": wide,
+                    "note": "stage-2 bucketed grad reduce-scatter (half "
+                            "the all-reduce bytes)"}
+            require[("dp", "all-gather")] = {
+                "dtypes": {"f32"},
+                "note": "the end-of-step param all-gather XLA inserts "
+                        "for the replicated out_sharding (priced as "
+                        "'ZeRO-2 param all-gather')"}
+            allow[("dp", "all-reduce")] = (
+                "residual psums for unscatterable leaves")
+        else:
+            if int8:
+                require[("dp", "collective-permute")] = {
+                    "dtypes": {"s8"},
+                    "note": "quantized DP all-reduce ring (EQuARX "
+                            "schedule: int8 codes, f32 sidecar scales)"}
+                allow[("all", "collective-permute")] = (
+                    "the quantized ring over combined (dp x tp) groups "
+                    "for SP-replicated leaves")
+                allow[("tp", "collective-permute")] = (
+                    "the quantized ring's tp leg for SP-replicated "
+                    "leaves (their grads reduce over dp AND tp)")
+                allow[("dp", "all-reduce")] = (
+                    "small-leaf / scalar residuals")
+            else:
+                require[("dp", "all-reduce")] = {
+                    "dtypes": wide,
+                    "note": "the DP grad reduce (bucketed or whole-tree)"}
+            if zero_stage == 1:
+                require[("dp", "all-gather")] = {
+                    "dtypes": {"f32"},
+                    "note": "stage-1 param gather from the dp-sharded "
+                            "moment update (XLA-derived schedule)"}
+        allow[("all", "all-reduce")] = (
+            "whole-mesh sums (loss mean, SP-replicated leaf grads)")
+
+    if serving and tp > 1:
+        # inference programs: row-parallel psums on tp; gathers allowed
+        # (vocab-parallel logits, page views); nothing on dp. All
+        # serving kinds (decode / prefill_chunk / spec_verify) share one
+        # schedule today — when their wires diverge (e.g. a Pallas
+        # decode kernel drops the gather), differentiate on `kind` HERE
+        # so the contract tightens with the implementation.
+        require[("tp", "all-reduce")] = {
+            "dtypes": wide | {"s32", "u32"},
+            "note": f"row-parallel output psums + fused-sampler argmax "
+                    f"reductions ({kind or 'serving'} dispatch)"}
+        allow[("tp", "all-gather")] = "vocab/head gathers"
+        allow[("tp", "reduce-scatter")] = "XLA-derived scatters"
+        allow[("tp", "all-to-all")] = "XLA-derived rewrites"
+        allow[("tp", "collective-permute")] = "XLA-derived rotations"
+
+    return {"require": require, "allow": allow, "forbid": forbid}
+
+
 # -- cross-rank skew attribution (ISSUE 10) ------------------------------
 
 def rank_skew(records: List[Dict], tol: float = 0.20) -> Optional[Dict]:

@@ -14,7 +14,7 @@ to wall time exactly.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 # Every interval of wall time is attributed to exactly one of these.
 # "step" = dispatching the train step + blocked waiting on device results:

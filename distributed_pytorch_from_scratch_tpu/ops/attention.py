@@ -10,7 +10,6 @@ fused HBM-friendly path the reference lacks; both produce the same math.
 
 from __future__ import annotations
 
-import functools
 import math
 
 import jax

@@ -35,7 +35,6 @@ All ops MUST be called from inside `shard_map` code partitioned over `axis`.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax import lax
 
 

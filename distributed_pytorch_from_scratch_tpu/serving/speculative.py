@@ -58,7 +58,7 @@ from ..models.decode import (_filter_logits, _full_vocab_logits,
                              _paged_decode_one, _paged_prefill_chunk,
                              rope_tables)
 from .engine import PagedEngine, Request, _chunk_maps, _pow2_at_most
-from .kv_manager import POOL_SPEC, PagedKVPool, PoolExhausted, page_bytes
+from .kv_manager import PagedKVPool, PoolExhausted, page_bytes
 
 # Randomness stream tags: every speculative draw folds
 # (seed, absolute_position, TAG), so the drafter's proposal draw, the

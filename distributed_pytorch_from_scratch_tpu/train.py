@@ -24,7 +24,6 @@ import os
 import signal
 import threading
 import time
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,7 +31,7 @@ import numpy as np
 from jax.experimental import multihost_utils
 
 from .config import (IGNORE_INDEX, MODEL_PRESETS, REMAT_CHOICES, MeshConfig,
-                     ModelConfig, OptimizerConfig, TrainConfig, model_preset)
+                     ModelConfig, OptimizerConfig, model_preset)
 from .data.dataset import get_dataloader
 from .data.prefetch import Prefetcher, stack_window, window_stream
 from .models.transformer import Transformer

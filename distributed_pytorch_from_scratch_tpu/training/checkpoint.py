@@ -17,7 +17,6 @@ TP=2: the global arrays are identical).
 from __future__ import annotations
 
 import glob
-import json
 import os
 import re
 import threading

@@ -40,8 +40,6 @@ stage-invariant (XLA partial-sums per shard and all-reduces one scalar).
 
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass
 from typing import Any, NamedTuple, Tuple
 
 import jax

@@ -10,14 +10,12 @@ in HBM (no reallocation per step; the reference relies on torch's in-place
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..config import OptimizerConfig, TrainConfig
+from ..config import OptimizerConfig
 from ..models.transformer import Transformer
 from .optim import AdamState, adam_update, global_norm
 from .zero import (build_bucketed_grad_fn, build_zero3_grad_fn,
@@ -138,16 +136,24 @@ def _jit_with_zero(fn, model, mesh, zero_stage, moment_shardings,
     un-aliasing the Adam moments) shows up in the train log's compile
     report instead of as a quiet 2x optimizer-state footprint."""
     donate = (0, 1)
-    if not zero_stage:
-        return jax.jit(fn, donate_argnums=donate)
     if zero_stage >= 3:
         param_sh = zero3_shardings(model, mesh)
         moment_sh = (moment_shardings if moment_shardings is not None
                      else param_sh)
     else:
+        # Stage 0 pins its outputs too (moments on the params' own
+        # shardings): without out_shardings XLA picks output layouts
+        # freely, and on this jax/XLA a dozen small leaves (norm gains,
+        # biases) come back in a layout that does NOT match their donated
+        # input — the donation is silently dropped and those leaves
+        # double-buffer. Found by graftcheck's donation-aliased contract
+        # (ISSUE 11); value-parity is covered by the stage-0 train tests.
         param_sh = model.shardings(mesh)
-        moment_sh = (moment_shardings if moment_shardings is not None
-                     else zero1_moment_shardings(model, mesh))
+        if moment_shardings is not None:
+            moment_sh = moment_shardings
+        else:
+            moment_sh = (zero1_moment_shardings(model, mesh)
+                         if zero_stage else param_sh)
     scalar = NamedSharding(mesh, P())
     opt_sh = AdamState(step=scalar, mu=moment_sh, nu=moment_sh)
 

@@ -21,6 +21,13 @@ LOG_INTERVAL=${LOG_INTERVAL:-100}
 FINEWEB_URL=${FINEWEB_URL:-"https://huggingface.co/datasets/HuggingFaceFW/fineweb/resolve/main/sample/10BT/000_00000.parquet"}
 
 mkdir -p "$WORK"
+
+# Step 0: static contract check (ISSUE 11) — the graftcheck sweep + trace
+# contracts must be clean before burning accelerator time on a run whose
+# programs violate the priced comm schedule or silently drop a donation.
+echo "== Step 0: graftcheck static contracts"
+python scripts/graftcheck.py --json "$WORK/graftcheck.json"
+
 PARQUET="$WORK/fineweb.parquet"
 TEXTS="$WORK/texts.json"
 TOKENIZER="$WORK/tokenizer/tokenizer.json"

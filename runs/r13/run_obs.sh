@@ -32,6 +32,13 @@ echo "=== r13 obs pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
 step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
   || exit 17
 
+# 0. static preflight (ISSUE 11): the layer-1 graftcheck sweep, with the
+# report landed in the run dir so summarize_run.py renders it. --no-trace
+# because the trace contracts are CPU-CI's job (tests/test_graftcheck.py)
+# and must not burn chip-window seconds; a violation here is forensics in
+# the manifest, not a session abort.
+step graftcheck 240 python scripts/graftcheck.py --no-trace --json runs/r13/graftcheck.json
+
 # 1. fresh trajectory point + the regression gate against BENCH_r*.json
 bench_line 45mfast 1200 --model 45m --remat auto --seq_bucket 128 --steps_per_dispatch 16
 step gate 120 python scripts/check_bench_regression.py --fresh runs/r13/bench_45mfast.json

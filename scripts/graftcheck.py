@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""graftcheck — static contract checker for this repo (ISSUE 11).
+
+Layer 1 sweeps the source tree with AST lints for the codebase's known
+failure classes (compat-shim bypass, use-after-donate, host calls in
+traced code, PRNG key reuse, lock discipline, dead/unreachable code) —
+WITHOUT importing jax, so it runs on a box where jax is broken. Layer 2
+lowers the canonical programs on the virtual-CPU mesh and asserts the
+trace contracts (collective inventory == the priced schedule, int8 wire
+width, donation aliasing, ZeRO-3 ring discipline, recompile hazards).
+
+Usage:
+    python scripts/graftcheck.py                     # lints + contracts
+    python scripts/graftcheck.py --no-trace          # lints only, no jax
+    python scripts/graftcheck.py --full              # full program matrix
+    python scripts/graftcheck.py --json out.json     # versioned report
+    python scripts/graftcheck.py --list-rules
+    python scripts/graftcheck.py path/to/file.py     # sweep a subset
+
+Exit status: 0 clean, 1 violations or failed contracts, 2 usage errors.
+Suppress a finding with `# graftcheck: disable=<rule>` on its line; the
+rule catalog lives in docs/ANALYSIS.md.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ANALYSIS_DIR = os.path.join(REPO, "distributed_pytorch_from_scratch_tpu",
+                            "analysis")
+
+
+def load_analysis():
+    """Load the analysis package standalone BY PATH — no parent-package
+    import, hence no jax import, for the layer-1 sweep."""
+    name = "graftcheck_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ANALYSIS_DIR, "__init__.py"),
+        submodule_search_locations=[ANALYSIS_DIR])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to sweep (default: the repo)")
+    p.add_argument("--json", metavar="PATH", default=None,
+                   help="write the versioned JSON report here")
+    p.add_argument("--no-trace", action="store_true",
+                   help="skip layer 2 (no jax import; AST lints only)")
+    p.add_argument("--full", action="store_true",
+                   help="layer 2 runs the full program matrix "
+                        "(every zero stage x wire + all serving "
+                        "programs; slower)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (layer 1)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="show passing contracts' detail too")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    analysis = load_analysis()
+
+    if args.list_rules:
+        for rid, rule in sorted(analysis.RULES.items()):
+            print(f"{rid:<22} {rule.summary}")
+        return 0
+
+    t0 = time.time()
+    paths = args.paths or [REPO]
+    only = args.rules.split(",") if args.rules else None
+    if only:
+        unknown = sorted(set(only) - set(analysis.RULES))
+        if unknown:
+            # a typo'd --rules would otherwise filter out EVERY finding
+            # and report a false 'clean'
+            print(f"graftcheck: unknown rule id(s) {unknown}; known: "
+                  f"{sorted(analysis.RULES)}", file=sys.stderr)
+            return 2
+    violations, files = analysis.lint_paths(paths, only=only, root=REPO)
+
+    contracts = None
+    if not args.no_trace:
+        # the virtual 8-device CPU mesh must be configured before the
+        # first backend init (tests/conftest.py does the same dance)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+        sys.path.insert(0, REPO)  # scripts/ is not a package
+        from distributed_pytorch_from_scratch_tpu.analysis.contracts import (
+            run_trace_contracts)
+        contracts = run_trace_contracts(full=args.full)
+
+    doc = analysis.build_report(violations, files, contracts,
+                                duration_s=time.time() - t0)
+    if args.json:
+        analysis.report.write_report(doc, args.json)
+    print(analysis.format_report(doc, verbose=args.verbose))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

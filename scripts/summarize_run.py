@@ -355,6 +355,53 @@ def schema_warning_lines(rdir):
     return rows
 
 
+def graftcheck_lines(rdir):
+    """Render a graftcheck report (scripts/graftcheck.py --json) landed in
+    the run dir: verdict, violations, failed contracts. Validated through
+    the report's own schema contract first — a drifted report renders as
+    a loud warning, not a silently-empty section."""
+    try:
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from distributed_pytorch_from_scratch_tpu.analysis.report import (
+            validate_report)
+    except ImportError:
+        def validate_report(doc):
+            return []
+    rows = []
+    for p in sorted(glob.glob(os.path.join(rdir, "graftcheck*.json"))):
+        rel = os.path.relpath(p, rdir)
+        try:
+            doc = json.loads(open(p).read())
+        except ValueError as e:
+            rows.append(f"- `{rel}` unparseable ({e})")
+            continue
+        problems = validate_report(doc)
+        if problems:
+            rows.extend(f"- `{rel}` SCHEMA DRIFT: {prob}"
+                        for prob in problems)
+            continue
+        verdict = "clean" if doc.get("ok") else "VIOLATIONS"
+        contracts = doc.get("contracts") or []
+        failed = [c for c in contracts if not c.get("ok")]
+        rows.append(
+            f"- `{rel}`: {verdict} — "
+            f"{len(doc.get('violations', []))} lint violation(s) over "
+            f"{doc.get('files_scanned')} files, "
+            f"{len(contracts) - len(failed)}/{len(contracts)} trace "
+            f"contract(s) ok")
+        for v in doc.get("violations", [])[:10]:
+            rows.append(f"  - {v['path']}:{v['line']} [{v['rule']}] "
+                        f"{v['message'][:120]}")
+        for c in failed[:10]:
+            rows.append(f"  - FAIL {c['name']}"
+                        + (f" [{c['program']}]" if c.get("program") else "")
+                        + f": {c.get('detail', '')[:160]}")
+    return rows
+
+
 def manifest_failures(rdir):
     """Steps that failed, from the run_step manifest — forensics inline."""
     path = os.path.join(rdir, "session_manifest.jsonl")
@@ -420,6 +467,11 @@ def summarize(rdir):
         out.append("")
         out.append("Cross-rank phase skew (rank_phase_stats):")
         out.extend(skew)
+    gc = graftcheck_lines(rdir)
+    if gc:
+        out.append("")
+        out.append("Static contracts (scripts/graftcheck.py):")
+        out.extend(gc)
     drift = schema_warning_lines(rdir)
     if drift:
         out.append("")

@@ -137,6 +137,15 @@ CORE_LANE = {
     "test_data_pipeline.py": ["test_collate_semantics",
                               "test_token_json_schema",
                               "test_reference_shipped_tokenizer_loads"],
+    # graftcheck (ISSUE 11): every rule's positive + negative fixture pin
+    # and the clean-repo gate — the contract every future PR inherits.
+    # The trace contracts stay in the default lane (they pay compiles).
+    "test_graftcheck.py": [
+        "test_bad_fixture_triggers_exactly_its_rule[",
+        "test_good_fixture_stays_clean[",
+        "test_rule_count_meets_acceptance_floor",
+        "test_repo_sweep_is_clean",
+    ],
     # obs: cheap unit coverage of every component; the train-run smoke
     # stays in the fast lane (it costs a full compile)
     "test_profiler_trace.py": None,

@@ -1,0 +1,8 @@
+"""Known-bad / known-good snippet corpus for tests/test_graftcheck.py.
+
+Each rule ships a `bad_<rule>.py` (must trigger exactly that rule) and a
+`good_<rule>.py` (must stay clean). These files are NEVER imported — they
+exist to be parsed by the linter — and the directory is excluded from the
+repo sweep (`analysis/rules.EXCLUDE_DIRS`), so the deliberate violations
+here never fail the clean-repo gate.
+"""

@@ -1,0 +1,12 @@
+"""BAD: imports the experimental shard_map directly (bypasses the shim),
+and uses shimmed surface names without ever loading runtime/compat.py."""
+import jax
+from jax.experimental.shard_map import shard_map  # noqa: F401
+
+
+def size(axis):
+    return jax.lax.axis_size(axis)
+
+
+def smap(f, mesh, specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)

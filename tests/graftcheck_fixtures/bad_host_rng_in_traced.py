@@ -1,0 +1,11 @@
+"""BAD: numpy RNG inside a jitted function — ONE host draw frozen into the
+compiled program; every step replays it."""
+import numpy as np
+
+import jax
+
+
+@jax.jit
+def noisy_step(x):
+    noise = np.random.normal(size=x.shape)   # frozen at trace time
+    return x + noise

@@ -1,0 +1,10 @@
+"""BAD: a device_get inside a jitted body — host sync at trace time, and
+the r3 'honest-looking timing' lie when used around kernels."""
+import jax
+
+
+@jax.jit
+def step(x):
+    y = x * 2
+    host = jax.device_get(y)          # sync inside traced code
+    return y + host.sum()

@@ -1,0 +1,13 @@
+"""BAD: time.time() inside a scanned body — the timestamp is traced once
+and baked into the program as a constant."""
+import time
+
+import jax
+
+
+def run(xs):
+    def body(carry, x):
+        stamp = time.time()           # trace-time constant!
+        return carry + x, stamp
+
+    return jax.lax.scan(body, 0.0, xs)

@@ -1,0 +1,23 @@
+"""BAD: a ring shared with host threads, appended under the lock on the
+record path but drained WITHOUT it on the dump path — a torn snapshot
+under exactly the anomaly the recorder exists to capture."""
+import threading
+from collections import deque
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=16)
+        self.count = 0
+
+    def record(self, ev):
+        with self._lock:
+            self._ring.append(ev)
+            self.count += 1
+
+    def dump(self):
+        events = list(self._ring)
+        self._ring.clear()            # unlocked mutation: races record()
+        self.count = 0                # and so does this
+        return events

@@ -1,0 +1,18 @@
+"""BAD: one key consumed twice (identical draws), and a loop consuming an
+outer key every iteration without fold_in."""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)      # same key: correlated!
+    return a, b
+
+
+def sample_loop(shape, n):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, shape))   # same draw, n times
+    return out

@@ -1,0 +1,13 @@
+"""BAD: statements after an unconditional return / raise."""
+
+
+def f(x):
+    return x + 1
+    x = x * 2          # never runs
+
+
+def g(x):
+    if x < 0:
+        raise ValueError(x)
+        return -x      # never runs
+    return x

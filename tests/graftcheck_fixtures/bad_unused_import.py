@@ -1,0 +1,9 @@
+"""BAD: two imports nothing references."""
+import json
+from collections import OrderedDict
+
+import numpy as np
+
+
+def mean(xs):
+    return np.mean(xs)

@@ -1,0 +1,10 @@
+"""BAD: the PR 3 bench bug in miniature — params read (for a FLOPs count)
+AFTER being donated to the step program."""
+import jax
+
+
+def bench(step_raw, params, opt, batch):
+    step = jax.jit(step_raw, donate_argnums=(0, 1))
+    out = step(params, opt, batch)
+    flops = sum(p.size for p in jax.tree.leaves(params))  # dead buffer!
+    return out, flops

@@ -1,0 +1,13 @@
+"""GOOD: the package import loads runtime/compat.py first, so the shimmed
+surface is guaranteed present before any jax use."""
+import jax
+
+import distributed_pytorch_from_scratch_tpu  # noqa: F401  (loads compat)
+
+
+def size(axis):
+    return jax.lax.axis_size(axis)
+
+
+def smap(f, mesh, specs):
+    return jax.shard_map(f, mesh=mesh, in_specs=specs, out_specs=specs)

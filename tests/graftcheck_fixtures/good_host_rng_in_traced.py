@@ -1,0 +1,9 @@
+"""GOOD: randomness threaded as a jax PRNG key argument — fresh per call,
+traced as data."""
+import jax
+
+
+@jax.jit
+def noisy_step(x, key):
+    noise = jax.random.normal(key, x.shape)
+    return x + noise

@@ -1,0 +1,13 @@
+"""GOOD: the sync happens in the HOST loop, after the program returns —
+scripts/tpu_checks.py's shared-jit-wrapper idiom."""
+import jax
+
+
+@jax.jit
+def step(x):
+    return x * 2
+
+
+def timed(x):
+    y = step(x)
+    return jax.device_get(y)          # host side: fine

@@ -1,0 +1,23 @@
+"""GOOD: every mutation of the shared ring happens under the lock
+(obs/flight.py's actual discipline)."""
+import threading
+from collections import deque
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=16)
+        self.count = 0
+
+    def record(self, ev):
+        with self._lock:
+            self._ring.append(ev)
+            self.count += 1
+
+    def dump(self):
+        with self._lock:
+            events = list(self._ring)
+            self._ring.clear()
+            self.count = 0
+        return events

@@ -1,0 +1,20 @@
+"""GOOD: split before the second draw; fold_in per loop iteration — the
+serving fold_in(seed, position) schedule in miniature."""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a, b
+
+
+def sample_loop(shape, n):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for i in range(n):
+        step_key = jax.random.fold_in(key, i)
+        out.append(jax.random.normal(step_key, shape))
+    return out

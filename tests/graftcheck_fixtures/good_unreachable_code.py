@@ -1,0 +1,14 @@
+"""GOOD: early returns inside branches; every statement reachable."""
+
+
+def f(x):
+    if x < 0:
+        return -x
+    return x + 1
+
+
+def g(xs):
+    for x in xs:
+        if x is None:
+            continue
+        yield x

@@ -1,0 +1,19 @@
+"""GOOD: every import used (including one only via an attribute chain, one
+via a string annotation, and a compat-gated import in a try block)."""
+import json
+from typing import Optional
+
+import numpy as np
+
+try:
+    import scipy  # noqa: F401  (optional dep, availability-gated)
+except ImportError:
+    scipy = None
+
+
+def load(path) -> Optional[dict]:
+    return json.loads(open(path).read())
+
+
+def mean(xs: "np.ndarray"):
+    return np.mean(xs)

@@ -5,11 +5,9 @@ predicate), the suspect ranking, and the policy the 45m/gpt2 presets are
 known to need.
 """
 
-import jax.numpy as jnp
 import pytest
 
-from distributed_pytorch_from_scratch_tpu.config import (ModelConfig,
-                                                         model_preset)
+from distributed_pytorch_from_scratch_tpu.config import model_preset
 from distributed_pytorch_from_scratch_tpu.obs.attribution import (
     attribution, flash_tile_stats, format_attribution)
 from distributed_pytorch_from_scratch_tpu.training.memory import (

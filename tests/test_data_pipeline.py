@@ -15,7 +15,7 @@ import pytest
 from distributed_pytorch_from_scratch_tpu.config import (
     BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, UNK_TOKEN)
 from distributed_pytorch_from_scratch_tpu.data.dataset import (
-    DataLoader, TokenDataset, collate, get_dataloader)
+    TokenDataset, collate, get_dataloader)
 from distributed_pytorch_from_scratch_tpu.data.tokenizer import (
     pre_tokenize, train_bpe)
 

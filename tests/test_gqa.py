@@ -10,7 +10,6 @@ import pytest
 
 from distributed_pytorch_from_scratch_tpu import (MeshConfig, ModelConfig,
                                                   Transformer, make_mesh)
-from distributed_pytorch_from_scratch_tpu.config import IGNORE_INDEX
 from distributed_pytorch_from_scratch_tpu.models.decode import GreedyDecoder
 from distributed_pytorch_from_scratch_tpu.models.vanilla import (
     VanillaTransformer)

@@ -15,7 +15,7 @@ torch = pytest.importorskip("torch")
 
 from distributed_pytorch_from_scratch_tpu.config import OptimizerConfig
 from distributed_pytorch_from_scratch_tpu.training.optim import (
-    AdamState, adam_update, init_adam_state, onecycle_lr)
+    adam_update, init_adam_state, onecycle_lr)
 
 
 def test_onecycle_lr_matches_torch():

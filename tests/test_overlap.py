@@ -31,7 +31,7 @@ from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
 from distributed_pytorch_from_scratch_tpu.ops.collectives import (
     gather_from, reduce_scatter, split_to)
 from distributed_pytorch_from_scratch_tpu.ops.overlap import (
-    ag_matmul, bucket_partition, bucketed_psum, matmul_rs)
+    ag_matmul, bucket_partition, matmul_rs)
 from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
 from distributed_pytorch_from_scratch_tpu.training.zero import (
     build_bucketed_grad_fn)

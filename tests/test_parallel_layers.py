@@ -20,14 +20,13 @@ set 1000 to reproduce the reference's exact bar — run recorded in
 BASELINE.md).
 """
 
-import functools
 import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from distributed_pytorch_from_scratch_tpu.config import MeshConfig
 from distributed_pytorch_from_scratch_tpu.parallel.embedding import VocabParallelEmbedding

@@ -45,7 +45,7 @@ from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
 from distributed_pytorch_from_scratch_tpu.serving.engine import (
     ContinuousBatchingEngine, PagedEngine, Request)
 from distributed_pytorch_from_scratch_tpu.serving.kv_manager import (
-    PagedKVPool, PoolExhausted, kv_token_bytes, page_bytes)
+    PagedKVPool, PoolExhausted, page_bytes)
 from distributed_pytorch_from_scratch_tpu.training.zero import (
     build_bucketed_grad_fn)
 

@@ -17,7 +17,6 @@ import json
 import os
 
 import jax
-import numpy as np
 import pytest
 
 from distributed_pytorch_from_scratch_tpu.config import MeshConfig, ModelConfig

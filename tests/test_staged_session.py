@@ -179,7 +179,7 @@ def validate(argv):
     if prog.startswith("scripts/") and prog.endswith(".py"):
         name = os.path.basename(prog)[:-3]
         if name in ("tpu_checks", "make_image_corpus", "tune_flash_blocks",
-                    "check_bench_regression"):
+                    "check_bench_regression", "graftcheck"):
             mod = _load_script(name)
             return _parse_with(mod.parse_args, rest)
         if name == "run_step":

@@ -11,7 +11,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import PartitionSpec as P
 
 from distributed_pytorch_from_scratch_tpu.config import (
     IGNORE_INDEX, MeshConfig, ModelConfig)
