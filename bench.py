@@ -206,8 +206,22 @@ def parse_args(argv=None):
                         "arm (obs/flight.py) — PoolExhausted preemptions "
                         "dump flightdump_*.json to --obs_dir")
     p.add_argument("--obs_dir", default="bench_obs",
-                   help="--trace_requests/--flight_records output dir "
-                        "(metrics.jsonl + trace + flight dumps)")
+                   help="--trace_requests/--flight_records/--metrics_port "
+                        "output dir (metrics.jsonl + trace + flight dumps)")
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="--serving: live telemetry exporter on the paged "
+                        "arm (obs/telemetry.py) — gauges/counters at "
+                        "http://127.0.0.1:PORT/metrics.json and /metrics; "
+                        "0 = ephemeral; telemetry_snapshot events mirror "
+                        "into --obs_dir")
+    p.add_argument("--rollup_interval", type=float, default=1.0,
+                   help="--metrics_port: seconds between "
+                        "telemetry_snapshot events")
+    p.add_argument("--profile_on_anomaly", type=int, default=0,
+                   metavar="STEPS",
+                   help="--serving: arm a bounded jax.profiler window of "
+                        "N decode steps when a flight dump fires, cross-"
+                        "linked from the dump; needs --flight_records")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="--serving: add a SPECULATIVE arm to the A/B — a "
                         "'tiny'-preset drafter proposes K tokens per round, "
@@ -227,6 +241,19 @@ def parse_args(argv=None):
     if (args.trace_requests or args.flight_records) and not args.serving:
         p.error("--trace_requests/--flight_records are --serving knobs "
                 "(training runs get them from train.py's observer)")
+    if args.metrics_port is not None and not args.serving:
+        p.error("--metrics_port is a --serving knob here (training runs "
+                "get the exporter from train.py)")
+    if args.metrics_port is not None:
+        if args.metrics_port < 0:
+            p.error(f"--metrics_port must be >= 0 (0 = ephemeral), got "
+                    f"{args.metrics_port}")
+        if args.rollup_interval <= 0:
+            p.error("--rollup_interval must be > 0 (seconds between "
+                    "telemetry_snapshot events)")
+    if args.profile_on_anomaly and not args.flight_records:
+        p.error("--profile_on_anomaly arms on flight-dump triggers; add "
+                "--flight_records (and --serving)")
     if args.decode_weight_dtype != "native" and not args.serving:
         p.error("--decode_weight_dtype is a --serving knob")
     if args.remat is None:
@@ -545,19 +572,32 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     # dir cannot take writes (a silently traceless traced bench is worse
     # than none)
     obs_tracer = obs_writer = obs_rt = obs_flight = None
-    if args.trace_requests or args.flight_records:
+    obs_telemetry = obs_profiler = None
+    if args.trace_requests or args.flight_records \
+            or args.metrics_port is not None:
         from distributed_pytorch_from_scratch_tpu.obs import (
-            FlightRecorder, RequestTracer, SpanTracer)
+            FlightRecorder, RequestTracer, SpanTracer, TelemetryExporter)
         from distributed_pytorch_from_scratch_tpu.serving.serve import (
             require_writable_dir)
         from distributed_pytorch_from_scratch_tpu.training.metrics import (
-            MetricsWriter)
-        require_writable_dir(args.obs_dir,
-                             "--trace_requests/--flight_records")
+            AnomalyProfiler, MetricsWriter)
+        require_writable_dir(
+            args.obs_dir,
+            "--trace_requests/--flight_records/--metrics_port")
         obs_tracer = SpanTracer(args.obs_dir, process_name="bench-serving")
         obs_writer = MetricsWriter(args.obs_dir, process_index=0)
+        if args.metrics_port is not None:
+            obs_telemetry = TelemetryExporter(
+                writer=obs_writer, rollup_interval=args.rollup_interval)
+            port = obs_telemetry.start(args.metrics_port)
+            print(f"telemetry exporter: http://127.0.0.1:{port}"
+                  f"/metrics.json", file=sys.stderr)
         if args.flight_records:
-            obs_flight = FlightRecorder(args.obs_dir)
+            if args.profile_on_anomaly:
+                obs_profiler = AnomalyProfiler(
+                    args.obs_dir, window_steps=args.profile_on_anomaly)
+            obs_flight = FlightRecorder(args.obs_dir,
+                                        profiler=obs_profiler)
         if args.trace_requests:
             obs_rt = RequestTracer(writer=obs_writer, tracer=obs_tracer,
                                    flight=obs_flight)
@@ -568,12 +608,18 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             num_pages=num_pages, prefill_chunk=args.prefill_chunk,
             kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
             tracer=obs_tracer, writer=obs_writer,
-            request_tracer=obs_rt, flight=obs_flight)
+            request_tracer=obs_rt, flight=obs_flight,
+            telemetry=obs_telemetry)
         paged_summary = run_loadgen(paged, burst())
         paged_rate = paged_summary["tokens_per_sec"]
     finally:
         # a mid-run failure is exactly when the trace matters: finalise
         # trace.json + flush the events before the exception propagates
+        # (profiler -> exporter -> tracer -> writer, the serve.py order)
+        if obs_profiler is not None:
+            obs_profiler.close()
+        if obs_telemetry is not None:
+            obs_telemetry.close()
         if obs_tracer is not None:
             obs_tracer.close()
         if obs_writer is not None:
@@ -725,12 +771,19 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         "num_pages": num_pages,
         "kv_capacity_ratio": kv_capacity_ratio,
         # ISSUE 10: where the per-request timelines / flight dumps landed
-        **({"obs_dir": args.obs_dir} if (args.trace_requests
-                                         or args.flight_records) else {}),
+        **({"obs_dir": args.obs_dir}
+           if (args.trace_requests or args.flight_records
+               or args.metrics_port is not None) else {}),
         **({"worst_ttft_rids": paged_summary["worst_ttft_rids"]}
            if "worst_ttft_rids" in paged_summary else {}),
         **({"flight_dumps": list(obs_flight.dumps)}
            if obs_flight is not None else {}),
+        # ISSUE 12: the live endpoint + anomaly captures, when armed
+        **({"metrics_port": obs_telemetry.port,
+            "telemetry_snapshots": obs_telemetry.snapshots}
+           if obs_telemetry is not None else {}),
+        **({"anomaly_profiles": list(obs_profiler.captures)}
+           if obs_profiler is not None else {}),
         **spec_rec,
         "ttft_ms_p50": paged_summary["ttft_ms_p50"],
         "ttft_ms_p95": paged_summary["ttft_ms_p95"],

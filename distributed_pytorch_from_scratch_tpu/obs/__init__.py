@@ -1,30 +1,36 @@
 """Observability: step-timeline tracing, goodput accounting, compiled-
-program introspection, a training-health sentinel, a hang watchdog, and
+program introspection, a training-health sentinel, a hang watchdog,
 (v2, ISSUE 10) per-request tracing, an anomaly flight recorder, and
-cross-rank skew attribution.
+cross-rank skew attribution, and (v3, ISSUE 12) the live telemetry
+plane: per-process exporters, the fleet collector, cross-process trace
+propagation, and anomaly-triggered device profiling.
 
 See docs/OBSERVABILITY.md for the operator's view (trace format, goodput
-buckets, sentinel thresholds, flight-dump walkthrough).
+buckets, sentinel thresholds, flight-dump walkthrough, live endpoints).
 """
 
 from .attribution import (attribution, flash_tile_stats, format_attribution,
                           rank_skew)
+from .collector import FleetCollector, JsonlTailer
 from .flight import FlightRecorder
 from .goodput import BUCKETS, GoodputMeter
 from .introspect import analyze_compiled, format_analysis, parse_collectives
 from .observer import TrainObserver
-from .reqtrace import RequestTracer
+from .reqtrace import RequestTracer, TraceContext, merge_traces
 from .schema import (EVENT_REQUIRED, EVENT_SCHEMA_VERSION, validate_jsonl,
                      validate_record)
 from .sentinel import HealthSentinel, TrainingHealthError
+from .telemetry import TelemetryExporter, fleet_slo_attainment
 from .trace import SpanTracer
 from .watchdog import HangWatchdog
 
 __all__ = [
-    "BUCKETS", "EVENT_REQUIRED", "EVENT_SCHEMA_VERSION", "FlightRecorder",
-    "GoodputMeter", "HangWatchdog", "HealthSentinel", "RequestTracer",
-    "SpanTracer", "TrainObserver", "TrainingHealthError",
+    "BUCKETS", "EVENT_REQUIRED", "EVENT_SCHEMA_VERSION", "FleetCollector",
+    "FlightRecorder", "GoodputMeter", "HangWatchdog", "HealthSentinel",
+    "JsonlTailer", "RequestTracer", "SpanTracer", "TelemetryExporter",
+    "TraceContext", "TrainObserver", "TrainingHealthError",
     "analyze_compiled", "attribution", "flash_tile_stats",
-    "format_analysis", "format_attribution", "parse_collectives",
-    "rank_skew", "validate_jsonl", "validate_record",
+    "fleet_slo_attainment", "format_analysis", "format_attribution",
+    "merge_traces", "parse_collectives", "rank_skew", "validate_jsonl",
+    "validate_record",
 ]

@@ -31,13 +31,23 @@ from .schema import EVENT_SCHEMA_VERSION
 
 
 class FlightRecorder:
+    """`profiler` (ISSUE 12): an optional `training.metrics.AnomalyProfiler`
+    (duck-typed: `.arm(tag)` -> capture path | None, `.tick(step, sync)`).
+    Every successful `dump()` ARMS it, and the capture path it will write
+    to is stamped into the dump as `"profile"` — so an anomaly's flight
+    dump cross-links the device profile of the steps around it. The
+    owning host loop drives `tick()` once per dispatch; arming from the
+    dump path (any thread) only flips a flag."""
+
     def __init__(self, dump_dir: str, maxlen: int = 512, max_dumps: int = 8,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 profiler=None):
         if maxlen < 1:
             raise ValueError(f"flight ring maxlen must be >= 1, got {maxlen}")
         self.dump_dir = dump_dir
         self.maxlen = maxlen
         self.max_dumps = max_dumps
+        self.profiler = profiler
         self._clock = clock
         self._ring: deque = deque(maxlen=maxlen)
         self._lock = threading.Lock()
@@ -56,6 +66,15 @@ class FlightRecorder:
         with self._lock:
             self._ring.append(ev)
             self.recorded += 1
+
+    def tick(self, step: int, sync=None) -> None:
+        """Host-loop heartbeat for the anomaly profiler: starts an armed
+        `jax.profiler` window at the next step boundary and stops it when
+        the window elapses. Call once per dispatch from the thread that
+        owns the device (never from the watchdog thread — jax profiling
+        is driven from the host loop; arming is the cross-thread part)."""
+        if self.profiler is not None:
+            self.profiler.tick(step, sync=sync)
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -86,10 +105,16 @@ class FlightRecorder:
             self._dump_seq += 1
             path = os.path.join(
                 self.dump_dir, f"flightdump_{tag}_{seq:03d}.json")
+        # arm the anomaly profiler BEFORE writing, so the dump can carry
+        # the capture path it cross-links (None when profiling is off,
+        # the capture budget is spent, or no host loop ever ticks again)
+        profile_path = (self.profiler.arm(tag)
+                        if self.profiler is not None else None)
         doc = {
             "schema_version": EVENT_SCHEMA_VERSION,
             "tag": tag,
             "trigger": {"ts": round(self._clock(), 6), **trigger},
+            "profile": profile_path,
             "ring": ring,
             "ring_maxlen": self.maxlen,
             "recorded_total": self.recorded,

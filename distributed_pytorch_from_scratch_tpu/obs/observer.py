@@ -29,17 +29,27 @@ class TrainObserver:
     def __init__(self, log_dir: str, writer=None, trace: bool = True,
                  watchdog_secs: float = 0.0, sentinel: bool = True,
                  spike_factor: float = 3.0, halt_on_nonfinite: bool = True,
-                 process_index: int = 0, flight_ring: int = 256):
+                 process_index: int = 0, flight_ring: int = 256,
+                 profile_on_anomaly: int = 0):
         self.writer = writer
         self.process_index = process_index
         self.tracer = SpanTracer(log_dir, enabled=trace, pid=process_index,
                                  process_name=f"train-p{process_index}")
         self.goodput = GoodputMeter()
+        # anomaly-triggered device profiling (ISSUE 12): a flight dump
+        # arms a bounded jax.profiler window that tick()s from heartbeat
+        profiler = None
+        if profile_on_anomaly > 0 and flight_ring > 0:
+            from ..training.metrics import AnomalyProfiler
+            profiler = AnomalyProfiler(log_dir,
+                                       window_steps=profile_on_anomaly)
+        self.profiler = profiler
         # the anomaly flight recorder: every span/heartbeat lands in the
         # ring, and the sentinel/watchdog flush it on their halt/stall
         # paths so a post-mortem has the preceding seconds, not just the
         # triggering event (flight_ring 0 disables)
-        self.flight = (FlightRecorder(log_dir, maxlen=flight_ring)
+        self.flight = (FlightRecorder(log_dir, maxlen=flight_ring,
+                                      profiler=profiler)
                        if flight_ring > 0 else None)
         self.sentinel = (HealthSentinel(
             log_dir, spike_factor=spike_factor,
@@ -87,11 +97,15 @@ class TrainObserver:
     def instant(self, name: str, **args) -> None:
         self.tracer.instant(name, **args)
 
-    def heartbeat(self, step: int, tokens: int = 0, steps: int = 1) -> None:
-        """Called once per completed dispatch: liveness + progress."""
+    def heartbeat(self, step: int, tokens: int = 0, steps: int = 1,
+                  sync=None) -> None:
+        """Called once per completed dispatch: liveness + progress.
+        `sync`: a device value from this dispatch — the anomaly
+        profiler's stop barrier, so an armed window never truncates."""
         self.goodput.add_progress(tokens, steps)
         if self.flight is not None:
             self.flight.record("heartbeat", step=step, tokens=tokens)
+            self.flight.tick(step, sync=sync)
         if self.watchdog is not None:
             self.watchdog.beat(step=step)
 
@@ -131,6 +145,8 @@ class TrainObserver:
         self._closed = True
         if self.watchdog is not None:
             self.watchdog.close()
+        if self.profiler is not None:
+            self.profiler.close()
         summary = self.goodput.summary()
         if self.writer is not None:
             self.writer.event("goodput_summary", **summary)

@@ -21,8 +21,11 @@ from typing import Dict, List, Tuple
 
 # Bump when an event's field contract changes incompatibly. Version 2 =
 # the ISSUE-10 schema: versioned events + the request-trace/flight/skew
-# event family. (Version 1 is retroactively "any pre-versioned event".)
-EVENT_SCHEMA_VERSION = 2
+# event family. Version 3 = the ISSUE-12 live-telemetry family
+# (telemetry_snapshot / fleet_rollup / rotated continuations) plus the
+# cross-process request_trace fields (process, t0_wall, clock_offset_ms).
+# (Version 1 is retroactively "any pre-versioned event".)
+EVENT_SCHEMA_VERSION = 3
 
 # tag -> fields a consumer may key on (presence contract, not types).
 # Only EVENT tags appear here — scalar ({"tag", "value", "step"}) and text
@@ -40,6 +43,16 @@ EVENT_REQUIRED: Dict[str, Tuple[str, ...]] = {
     "rank_phase_stats": ("process", "phases_s", "steps"),
     "sentinel/nonfinite": ("reason",),
     "watchdog/stall": ("process", "stalled_for"),
+    # -- ISSUE 12: the live-telemetry family -----------------------------
+    # periodic exporter registry mirror (obs/telemetry.py); the fleet
+    # collector keys on both maps and the producing process index
+    "telemetry_snapshot": ("gauges", "counters", "process"),
+    # fleet-level aggregation (obs/collector.py); consumers key on the
+    # proc count and the cross-proc attainment map
+    "fleet_rollup": ("procs", "slo_attainment"),
+    # size-based MetricsWriter rotation: the LAST record of a rotated-out
+    # file names its continuation; tailers follow `next`
+    "rotated": ("next",),
 }
 
 

@@ -93,6 +93,9 @@ class Request:
     tenant: str = "default"              # fair-queuing bucket (SLOScheduler)
     slo_class: Optional[str] = None      # TTFT deadline class (None=default)
     trace_id: Optional[str] = None       # per-request trace (obs/reqtrace)
+    trace_ctx: Optional[dict] = None     # wire TraceContext from another
+    #                                      process (obs/reqtrace, ISSUE 12):
+    #                                      submit CONTINUES that trace
     tokens: List[int] = field(default_factory=list)
     submit_t: Optional[float] = None     # entered the admission queue
     admit_t: Optional[float] = None      # left the queue (prefill dispatch)
@@ -124,6 +127,14 @@ class Request:
                 or len(self.tokens) < 2):
             return None
         return (self.finish_t - self.first_token_t) / (len(self.tokens) - 1)
+
+
+def _wire_ctx(req: Request):
+    """Deserialize a request's cross-process trace handoff, if any."""
+    if req.trace_ctx is None:
+        return None
+    from ..obs.reqtrace import TraceContext
+    return TraceContext.from_wire(req.trace_ctx)
 
 
 def decode_prompts(engine: "ContinuousBatchingEngine", prompts,
@@ -186,7 +197,7 @@ class ContinuousBatchingEngine:
                  max_queue: int = 0, debug_host_sampler: bool = False,
                  decode_weight_dtype=None,
                  tracer=None, writer=None, request_tracer=None,
-                 flight=None, clock=time.monotonic):
+                 flight=None, telemetry=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -213,6 +224,7 @@ class ContinuousBatchingEngine:
         self.writer = writer
         self.rt = request_tracer        # obs.reqtrace.RequestTracer | None
         self.flight = flight            # obs.flight.FlightRecorder | None
+        self.telemetry = telemetry      # obs.telemetry.TelemetryExporter
         self._dtype = resolve_dtype(model.cfg.compute_dtype)
         self._table_len = max(model.cfg.maxlen, buf_len)
         # sampling knobs kept on the engine: the fused in-program sampler
@@ -306,10 +318,12 @@ class ContinuousBatchingEngine:
     def submit(self, req: Request) -> None:
         """FIFO enqueue (raises scheduler.QueueFull past the backpressure
         bound). An accepted request opens its trace timeline at submit_t
-        (rejected ones never get one — they have no life to explain)."""
+        (rejected ones never get one — they have no life to explain); a
+        `trace_ctx` handed over from another process CONTINUES that
+        trace instead (obs/reqtrace.TraceContext)."""
         self.scheduler.submit(req)
         if self.rt is not None:
-            self.rt.begin(req)
+            self.rt.begin(req, ctx=_wire_ctx(req))
 
     def has_work(self) -> bool:
         return bool(self.scheduler.pending or self._slot_req)
@@ -434,6 +448,15 @@ class ContinuousBatchingEngine:
             self.flight.record("pool_stats", live=len(self._slot_req),
                                free_slots=self.pool.free_slots,
                                queued=self.scheduler.pending)
+            # `tok` is host-side already (the np.asarray above), so this
+            # step's device work is done — safe profiler stop barrier
+            self.flight.tick(self.decode_steps)
+        if self.telemetry is not None:
+            tel = self.telemetry
+            tel.gauge("serve/live", len(self._slot_req))
+            tel.gauge("serve/queue_depth", self.scheduler.pending)
+            tel.rate("serve/tokens_per_sec", self.generated_tokens)
+            tel.counter("serve/decode_steps", self.decode_steps)
         for slot, req in list(self._slot_req.items()):
             # the pending token was written at `pos` by this dispatch: it
             # is now part of the output (mirrors make_generate's buf write)
@@ -550,7 +573,7 @@ class PagedEngine:
                  max_queue: int = 0, debug_host_sampler: bool = False,
                  kv_dtype=None, decode_weight_dtype=None,
                  tracer=None, writer=None, request_tracer=None,
-                 flight=None, clock=time.monotonic):
+                 flight=None, telemetry=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -588,6 +611,13 @@ class PagedEngine:
         self.writer = writer
         self.rt = request_tracer        # obs.reqtrace.RequestTracer | None
         self.flight = flight            # obs.flight.FlightRecorder | None
+        self.telemetry = telemetry      # obs.telemetry.TelemetryExporter
+        # online per-class SLO accounting (ISSUE 12): {class: [completed,
+        # hit]}, updated at every _complete — feeds the live exporter
+        # gauges AND the in-run attainment-collapse flight trigger (the
+        # post-run loadgen check can only dump after the damage is done)
+        self._slo_counts: Dict[str, list] = {}
+        self.slo_collapsed: set = set()
         self._dtype = resolve_dtype(model.cfg.compute_dtype)
         self._table_len = max(model.cfg.maxlen, self.buf_len)
         # fused in-program sampling is the only production path; the knobs
@@ -702,7 +732,7 @@ class PagedEngine:
                 f"— raise --num_pages or lower the budget")
         self.scheduler.submit(req)
         if self.rt is not None:
-            self.rt.begin(req)
+            self.rt.begin(req, ctx=_wire_ctx(req))
 
     def has_work(self) -> bool:
         return bool(self.scheduler.pending or self._slot_req
@@ -1080,6 +1110,11 @@ class PagedEngine:
                                pages_in_use=used,
                                free_pages=self.pool.free_pages,
                                queued=self.scheduler.pending)
+            # device work for this step is already host-side (`tok`);
+            # safe point to drive an armed anomaly-profiler window
+            self.flight.tick(self.decode_steps)
+        if self.telemetry is not None:
+            self._publish_telemetry(used, live_tokens)
         for slot, req in list(self._slot_req.items()):
             if self.rt is not None:
                 self.rt.mark(req, "decode", now)
@@ -1097,9 +1132,59 @@ class PagedEngine:
             else:
                 self._tokens[slot] = cand
 
+    def _publish_telemetry(self, pages_used: int, live_tokens: int) -> None:
+        """Per-decode-step exporter update (ISSUE 12): a handful of lock-
+        guarded dict stores — the pinned hot-path budget is why nothing
+        here formats strings or touches I/O."""
+        tel = self.telemetry
+        tel.gauge("serve/live", len(self._slot_req))
+        tel.gauge("serve/prefilling", len(self._prefilling))
+        tel.gauge("serve/queue_depth", self.scheduler.pending)
+        tel.gauge("serve/pages_in_use", pages_used)
+        tel.gauge("serve/free_pages", self.pool.free_pages)
+        tel.gauge("serve/num_pages", self.pool.num_pages)
+        if pages_used:
+            tel.gauge("serve/kv_util",
+                      live_tokens / (pages_used * self.page_size))
+        tel.rate("serve/tokens_per_sec", self.generated_tokens)
+        tel.counter("serve/decode_steps", self.decode_steps)
+        tel.counter("serve/preemptions", self.preemptions)
+
+    def _account_slo(self, req: Request) -> None:
+        """Fold one completion into the live per-class attainment; an
+        in-run collapse (< 50% attained over >= 4 completions) freezes
+        the flight ring ONCE per class, while the pool/scheduler history
+        that produced it is still in the ring — and, when an anomaly
+        profiler is armed, cross-links a device capture of the very next
+        steps."""
+        cls = req.slo_class or self.scheduler.default_class
+        deadline = self.scheduler.classes.get(cls)
+        if deadline is None:
+            return
+        c = self._slo_counts.setdefault(cls, [0, 0])
+        c[0] += 1
+        if req.ttft_s is not None and req.ttft_s <= deadline:
+            c[1] += 1
+        attained = c[1] / c[0]
+        if self.telemetry is not None:
+            tel = self.telemetry
+            tel.counter(f"slo/{cls}/completed", c[0])
+            tel.counter(f"slo/{cls}/hit", c[1])
+            tel.gauge(f"slo/{cls}/attained", attained)
+        if (self.flight is not None and c[0] >= 4 and attained < 0.5
+                and cls not in self.slo_collapsed):
+            self.slo_collapsed.add(cls)
+            self.flight.dump(
+                {"kind": "slo_attainment_collapse", "slo_class": cls,
+                 "completed": c[0], "attained": round(attained, 4),
+                 "deadline_s": deadline},
+                tag="slo_collapse")
+
     def _complete(self, req: Request, done: List[Request]) -> None:
         self.completed.append(req)
         done.append(req)
+        if self.scheduler.classes:
+            self._account_slo(req)
         if self.rt is not None:
             self.rt.retire(req)
         if self.writer is not None:

@@ -231,8 +231,12 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
         # the pool/scheduler history that produced it is still in there
         flight = getattr(engine, "flight", None)
         if flight is not None:
+            # classes whose collapse the engine already dumped ONLINE
+            # (PagedEngine._account_slo) don't need a second post-run dump
+            dumped = getattr(engine, "slo_collapsed", set())
             for name, c in sorted(att.items()):
-                if c["completed"] >= 4 and c["attained"] < 0.5:
+                if (c["completed"] >= 4 and c["attained"] < 0.5
+                        and name not in dumped):
                     flight.dump(
                         {"kind": "slo_attainment_collapse",
                          "slo_class": name, **c},

@@ -190,6 +190,29 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     g.add_argument("--flight_ring", type=int, default=512,
                    help="--flight_records: ring capacity (events); "
                         "0 disables the recorder (train.py semantics)")
+    g.add_argument("--metrics_port", type=int, default=None,
+                   help="live telemetry exporter (obs/telemetry.py): "
+                        "serve gauges/counters at http://127.0.0.1:PORT"
+                        "/metrics.json (JSON) and /metrics (Prometheus "
+                        "text); 0 = ephemeral (the bound port is printed "
+                        "and lands in the summary record). A busy port "
+                        "refuses loudly up front")
+    g.add_argument("--rollup_interval", type=float, default=1.0,
+                   help="--metrics_port: seconds between "
+                        "telemetry_snapshot events mirrored into "
+                        "metrics.jsonl (the fleet collector's food)")
+    g.add_argument("--profile_on_anomaly", type=int, default=0,
+                   metavar="STEPS",
+                   help="arm a bounded jax.profiler window of N decode "
+                        "steps when a flight dump fires (PoolExhausted "
+                        "preemption, SLO collapse), cross-linked from "
+                        "the dump's 'profile' field; needs "
+                        "--flight_records; 0 = off")
+    g.add_argument("--metrics_max_mb", type=float, default=0.0,
+                   help="rotate metrics.jsonl past N MiB (-> "
+                        "metrics.001.jsonl ... via schema-valid "
+                        "'rotated' continuation events; consumers "
+                        "follow the chain); 0 = unbounded")
 
     g = p.add_argument_group("other")
     g.add_argument("--log_dir", default="serve_logs",
@@ -231,6 +254,15 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                 "--speculate K")
     if args.arrival == "replay" and not args.replay and not args.dry_run:
         p.error("--arrival replay needs --replay PATH")
+    if args.profile_on_anomaly and not args.flight_records:
+        p.error("--profile_on_anomaly arms on flight-dump triggers; add "
+                "--flight_records")
+    if args.metrics_port is not None and args.metrics_port < 0:
+        p.error(f"--metrics_port must be >= 0 (0 = ephemeral), got "
+                f"{args.metrics_port}")
+    if args.metrics_port is not None and args.rollup_interval <= 0:
+        p.error("--rollup_interval must be > 0 (seconds between "
+                "telemetry_snapshot events)")
     if not args.dry_run and not args.random_init and not args.ckpt_dir:
         p.error("pick a weight source: --ckpt_dir, --random_init, or "
                 "--dry_run")
@@ -317,14 +349,17 @@ def _build_drafter(args, vocab_size: int, mesh, family: str):
 def serve(args: argparse.Namespace) -> dict:
     import time as _time
 
-    from ..obs import FlightRecorder, RequestTracer, SpanTracer
-    from ..training.metrics import MetricsWriter
+    from ..obs import (FlightRecorder, RequestTracer, SpanTracer,
+                       TelemetryExporter)
+    from ..training.metrics import AnomalyProfiler, MetricsWriter
     from .engine import ContinuousBatchingEngine
     from .loadgen import replay_requests, run_loadgen, synthetic_requests
 
-    if args.trace_requests or args.flight_records:
+    if args.trace_requests or args.flight_records \
+            or args.metrics_port is not None:
         require_writable_dir(
-            args.log_dir, "--trace_requests/--flight_records")
+            args.log_dir,
+            "--trace_requests/--flight_records/--metrics_port")
 
     eos_id = 1  # the shipped tokenizer's EOS (tokenizer/tokenizer.json)
     vocab_size = args.vocab_size
@@ -387,8 +422,23 @@ def serve(args: argparse.Namespace) -> dict:
         buf_len = cap
 
     tracer = SpanTracer(args.log_dir, process_name="serve")
-    writer = MetricsWriter(args.log_dir, process_index=0)
-    flight = (FlightRecorder(args.log_dir, maxlen=args.flight_ring)
+    writer = MetricsWriter(args.log_dir, process_index=0,
+                           max_bytes=int(args.metrics_max_mb * 2**20))
+    # live telemetry exporter (ISSUE 12): starts BEFORE the engine so a
+    # hung prefill is still scrapeable; a busy port dies loudly here
+    telemetry = None
+    if args.metrics_port is not None:
+        telemetry = TelemetryExporter(
+            writer=writer, rollup_interval=args.rollup_interval)
+        port = telemetry.start(args.metrics_port)
+        print(f"telemetry exporter: http://127.0.0.1:{port}/metrics.json "
+              f"(Prometheus text at /metrics)", file=sys.stderr)
+    profiler = (AnomalyProfiler(args.log_dir,
+                                window_steps=args.profile_on_anomaly)
+                if args.profile_on_anomaly and args.flight_ring > 0
+                else None)
+    flight = (FlightRecorder(args.log_dir, maxlen=args.flight_ring,
+                             profiler=profiler)
               if args.flight_records and args.flight_ring > 0 else None)
     rt = (RequestTracer(writer=writer, tracer=tracer, flight=flight,
                         clock=_time.monotonic)
@@ -409,7 +459,7 @@ def serve(args: argparse.Namespace) -> dict:
                 slo_classes=parse_slo_classes(args.slo_classes),
                 default_class=args.default_class,
                 max_queue=args.queue_limit, tracer=tracer, writer=writer,
-                request_tracer=rt, flight=flight)
+                request_tracer=rt, flight=flight, telemetry=telemetry)
             if args.speculate:
                 from .speculative import SpeculativeEngine
                 dmodel, dparams = _build_drafter(args, cfg.vocab_size, mesh,
@@ -434,9 +484,16 @@ def serve(args: argparse.Namespace) -> dict:
                 debug_host_sampler=args.debug_host_sampler,
                 decode_weight_dtype=wdtype,
                 tracer=tracer, writer=writer,
-                request_tracer=rt, flight=flight)
+                request_tracer=rt, flight=flight, telemetry=telemetry)
         summary = run_loadgen(engine, requests)
     finally:
+        # profiler before exporter before writer: an open capture window
+        # finalises, the exporter's LAST snapshot event lands, then the
+        # jsonl stream closes
+        if profiler is not None:
+            profiler.close()
+        if telemetry is not None:
+            telemetry.close()
         path = tracer.close()
         writer.close()
     fmt = lambda v: "-" if v is None else f"{v:.1f}"
@@ -497,10 +554,17 @@ def serve(args: argparse.Namespace) -> dict:
         rec["decode_weight_dtype"] = args.decode_weight_dtype
     if args.trace_requests:
         rec["trace_requests"] = True
+    if telemetry is not None:
+        rec["metrics_port"] = telemetry.port
+        rec["telemetry_snapshots"] = telemetry.snapshots
     if flight is not None:
         rec["flight_dumps"] = list(flight.dumps)
         for d in flight.dumps:
             print(f"flight dump written: {d}", file=sys.stderr)
+    if profiler is not None:
+        rec["anomaly_profiles"] = list(profiler.captures)
+        for d in profiler.captures:
+            print(f"anomaly profile captured: {d}", file=sys.stderr)
     print(json.dumps(rec))
     return summary
 

@@ -530,6 +530,13 @@ class SpeculativeEngine(PagedEngine):
                                free_pages=self.pool.free_pages,
                                drafter_pages_in_use=self.dpool.pages_in_use,
                                queued=self.scheduler.pending)
+            # the verify round's D2H already synced this step's device
+            # work — safe point for an armed anomaly-profiler window
+            self.flight.tick(self.decode_steps)
+        if self.telemetry is not None:
+            self._publish_telemetry(used, live_tokens)
+            self.telemetry.gauge("serve/drafter_pages_in_use",
+                                 self.dpool.pages_in_use)
         for slot, req in list(self._slot_req.items()):
             na = int(n_acc[slot])
             n_att = min(k, int(qlen[slot]) - 1)

@@ -268,6 +268,23 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "heartbeats in a ring that sentinel halts and "
                         "watchdog stalls dump as flightdump_*.json "
                         "(docs/OBSERVABILITY.md; 0 disables)")
+    g.add_argument("--metrics_port", type=int, default=None,
+                   help="live telemetry exporter (obs/telemetry.py): step "
+                        "time, tokens/s, MFU, goodput buckets at "
+                        "http://127.0.0.1:PORT/metrics.json and /metrics "
+                        "(Prometheus text). Multi-process runs bind "
+                        "PORT + process_index; 0 = ephemeral. A busy "
+                        "port refuses loudly up front")
+    g.add_argument("--rollup_interval", type=float, default=5.0,
+                   help="--metrics_port: seconds between "
+                        "telemetry_snapshot events mirrored into "
+                        "metrics.jsonl (the fleet collector's food)")
+    g.add_argument("--profile_on_anomaly", type=int, default=0,
+                   metavar="STEPS",
+                   help="arm a bounded jax.profiler window of N dispatches "
+                        "when a flight dump fires (sentinel halt, watchdog "
+                        "stall), cross-linked from the dump's 'profile' "
+                        "field; needs --flight_ring > 0; 0 = off")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -288,7 +305,17 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "autodetect this; needed for CPU multi-process runs)")
     g.add_argument("--process_id", type=int, default=None,
                    help="multi-host: this process's id (see --num_processes)")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.metrics_port is not None:
+        # the serve.py refusals, verbatim: a run whose snapshot mirror
+        # silently never starts is the traceless-run failure mode
+        if args.metrics_port < 0:
+            p.error(f"--metrics_port must be >= 0 (0 = ephemeral), got "
+                    f"{args.metrics_port}")
+        if args.rollup_interval <= 0:
+            p.error("--rollup_interval must be > 0 (seconds between "
+                    "telemetry_snapshot events)")
+    return args
 
 
 def _bucket_window(window: dict, t_pad: int) -> dict:
@@ -389,11 +416,25 @@ def train(args: argparse.Namespace) -> dict:
     logs_dir = os.path.join(args.save_dir, "logs") if nproc == 1 else \
         os.path.join(args.save_dir, "logs", f"proc{proc_idx}")
     writer = MetricsWriter(logs_dir, process_index=proc_idx)
+    # live telemetry (ISSUE 12): per-process exporter endpoint — process i
+    # binds base+i so a multi-host launch script can compute every
+    # replica's scrape target from one flag; dies loudly on a busy port
+    telemetry = None
+    if args.metrics_port is not None:
+        from .obs import TelemetryExporter
+        telemetry = TelemetryExporter(
+            writer=writer, process_index=proc_idx,
+            rollup_interval=args.rollup_interval)
+        base = args.metrics_port
+        bound = telemetry.start(base + proc_idx if base else 0)
+        print(f"telemetry exporter[p{proc_idx}]: "
+              f"http://127.0.0.1:{bound}/metrics.json")
     observer = TrainObserver(
         logs_dir, writer=writer, trace=not args.no_trace,
         watchdog_secs=args.watchdog_secs, sentinel=not args.no_sentinel,
         spike_factor=args.sentinel_spike_factor,
-        process_index=proc_idx, flight_ring=args.flight_ring)
+        process_index=proc_idx, flight_ring=args.flight_ring,
+        profile_on_anomaly=args.profile_on_anomaly)
 
     try:
         dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -909,7 +950,7 @@ def train(args: argparse.Namespace) -> dict:
                                          != IGNORE_INDEX).sum())
                     steps_since += steps_in
                     observer.heartbeat(n, tokens=window["input_ids"].size,
-                                       steps=steps_in)
+                                       steps=steps_in, sync=loss)
                     # only DISPATCHED pulls count toward the ms/dispatch wait
                     # metric (dropped partial groups and the end-of-epoch
                     # sentinel would deflate it)
@@ -944,6 +985,22 @@ def train(args: argparse.Namespace) -> dict:
                         writer.scalar("device_memory_gib", device_memory_gib(), n)
                         if gnorm is not None:
                             writer.scalar("train/grad_norm", gnorm, n)
+                        if telemetry is not None:
+                            # same numbers the log line prints — the live
+                            # endpoint view; the goodput buckets ride too
+                            # (a dict copy per log interval, not per step)
+                            telemetry.gauge("train/tokens_per_sec", tps)
+                            telemetry.gauge("train/mfu", mfu)
+                            telemetry.gauge("train/loss_avg", avg)
+                            telemetry.gauge(
+                                "train/step_time_ms",
+                                1e3 * dt / max(steps_since, 1))
+                            telemetry.counter("train/step", n)
+                            gsum = observer.goodput.summary()
+                            telemetry.gauge("train/goodput",
+                                            gsum["goodput"])
+                            for b, v in gsum["buckets_s"].items():
+                                telemetry.gauge(f"train/bucket_s/{b}", v)
                         last_cum, last_log_n = cum, n
                         t_start, tokens_since, steps_since = time.time(), 0, 0
                         useful_since = 0
@@ -980,6 +1037,10 @@ def train(args: argparse.Namespace) -> dict:
             shutdown.restore()
             join_save()
             observer.close(print_summary=is_main)
+            # exporter after the observer (its final snapshot is the
+            # run's last registry state), before the writer it mirrors to
+            if telemetry is not None:
+                telemetry.close()
             writer.close()
 
         final_avg = float(accum_loss) / max(n - start_step, 1)
@@ -998,6 +1059,8 @@ def train(args: argparse.Namespace) -> dict:
         # is embedded (tests call it repeatedly). Both closes are
         # idempotent, so the happy path's finally running first is fine.
         observer.close(print_summary=False)
+        if telemetry is not None:
+            telemetry.close()
         writer.close()
         raise
 

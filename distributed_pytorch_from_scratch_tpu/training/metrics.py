@@ -27,7 +27,8 @@ class MetricsWriter:
     multi-host divergence is compared. Also a context manager, so the
     file handle closes on error paths."""
 
-    def __init__(self, log_dir: str, process_index: Optional[int] = None):
+    def __init__(self, log_dir: str, process_index: Optional[int] = None,
+                 max_bytes: int = 0):
         from ..runtime.mesh import process_info
         if process_index is None:
             process_index = process_info()[0]
@@ -36,6 +37,15 @@ class MetricsWriter:
         name = ("metrics.jsonl" if process_index == 0
                 else f"metrics.proc{process_index}.jsonl")
         self.path = os.path.join(log_dir, name)
+        # size-based rotation (ISSUE 12): once the current file passes
+        # max_bytes, a schema-valid `rotated` event naming the NEXT file
+        # is appended as its LAST line and the stream continues there
+        # (metrics.jsonl -> metrics.001.jsonl -> ...). The old file is
+        # never renamed, so a live tailer's open handle stays valid and
+        # follows the chain (obs/collector.JsonlTailer). 0 = unbounded.
+        self.max_bytes = max_bytes
+        self._base, self._ext = os.path.splitext(self.path)
+        self._gen = 0
         self._jsonl = open(self.path, "a")
         # the obs watchdog writes events from its daemon thread while the
         # train loop writes scalars — serialize, or lines tear
@@ -54,6 +64,20 @@ class MetricsWriter:
                 return
             self._jsonl.write(json.dumps(rec) + "\n")
             self._jsonl.flush()
+            if self.max_bytes and self._jsonl.tell() >= self.max_bytes:
+                self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        from ..obs.schema import EVENT_SCHEMA_VERSION
+        self._gen += 1
+        nxt = f"{self._base}.{self._gen:03d}{self._ext}"
+        self._jsonl.write(json.dumps(
+            {"tag": "rotated", "ts": time.time(),
+             "schema_version": EVENT_SCHEMA_VERSION,
+             "next": os.path.basename(nxt), "generation": self._gen}) + "\n")
+        self._jsonl.close()
+        self.path = nxt
+        self._jsonl = open(nxt, "a")
 
     def scalar(self, tag: str, value: float, step: int) -> None:
         self._write({"tag": tag, "value": float(value), "step": int(step),
@@ -185,7 +209,11 @@ class ProfilerTrace:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
-            print(f"profiler trace written to {self.log_dir}")
+            import sys
+            # stderr: serve.py/bench.py reserve stdout for the one
+            # machine-parsed JSON record
+            print(f"profiler trace written to {self.log_dir}",
+                  file=sys.stderr)
 
     def close(self, sync=None) -> None:
         if self._active:
@@ -193,9 +221,96 @@ class ProfilerTrace:
                 jax.block_until_ready(sync)
             jax.profiler.stop_trace()
             self._active = False
+            import sys
             print(f"profiler trace written to {self.log_dir} (window "
                   f"overlapped the end of training; it may cover fewer "
-                  f"steps than requested)")
+                  f"steps than requested)", file=sys.stderr)
+
+
+class AnomalyProfiler:
+    """Anomaly-triggered device profiling (ISSUE 12): when a flight dump
+    fires (sentinel halt, watchdog stall, PoolExhausted preemption, SLO
+    collapse), ARM a bounded `jax.profiler` window so the dump cross-links
+    a device timeline of the steps right after the anomaly — instead of
+    only host-side ring contents.
+
+    Split across threads by design: `arm()` may be called from ANY thread
+    (the watchdog's dump path included) and only records the request under
+    a lock; the actual `jax.profiler` start/stop runs inside `tick()`,
+    which the host loop calls once per dispatch — the same thread that
+    owns the device queue (reusing `ProfilerTrace`'s window mechanics, so
+    the stop blocks on `sync` and never truncates the profiled steps).
+    `max_captures` bounds what an anomaly storm can spend: device tracing
+    is the one obs tool too expensive to leave on, which is why it is
+    armed by anomalies rather than always-on."""
+
+    def __init__(self, log_dir: str, window_steps: int = 4,
+                 max_captures: int = 1):
+        if window_steps < 1:
+            raise ValueError(f"profile window must be >= 1 step, got "
+                             f"{window_steps}")
+        self.log_dir = log_dir
+        self.window_steps = window_steps
+        self.max_captures = max_captures
+        self._lock = threading.Lock()
+        self._pending = None          # (tag, capture_dir) awaiting a tick
+        self._armed_total = 0
+        self._trace: Optional[ProfilerTrace] = None  # tick-thread only
+        self.captures = []            # capture dirs actually written
+
+    def arm(self, tag: str) -> Optional[str]:
+        """Reserve a capture for the NEXT tick; returns the directory the
+        profile will land in (the flight dump stamps it), or None when the
+        capture budget is spent or a capture is already pending/active —
+        an anomaly storm profiles once, not once per dump."""
+        with self._lock:
+            if self._armed_total >= self.max_captures or \
+                    self._pending is not None or self._trace is not None:
+                return None
+            self._armed_total += 1
+            path = os.path.join(
+                self.log_dir,
+                f"profile_anomaly_{tag}_{self._armed_total:02d}")
+            self._pending = (tag, path)
+        return os.path.join(path, "profile")  # ProfilerTrace's subdir
+
+    def tick(self, step: int, sync=None) -> None:
+        """Drive the armed window from the host loop (one thread). The
+        window opens at this step and closes `window_steps` later;
+        `sync` is a device value from the last dispatched step, so the
+        stop never fires while profiled steps are still executing."""
+        with self._lock:
+            pending = self._pending
+            self._pending = None
+        if pending is not None and self._trace is None:
+            tag, path = pending
+            self._trace = ProfilerTrace(path, start_step=step,
+                                        num_steps=self.window_steps)
+            self._trace.maybe_start(step)
+            self.captures.append(self._trace.log_dir)
+        elif self._trace is not None:
+            self._trace.maybe_stop(step, sync=sync)
+            if self._trace._done:
+                self._trace = None
+
+    def close(self, sync=None) -> None:
+        """Finish an open window at run end (shorter than requested beats
+        a truncated unreadable capture). An ARMED window the loop never
+        ticked again (the anomaly fired on the run's last step) still
+        captures whatever device activity remains right now — the dump's
+        cross-linked path must point at a readable trace, not at
+        nothing."""
+        with self._lock:
+            pending = self._pending
+            self._pending = None
+        if pending is not None and self._trace is None:
+            _, path = pending
+            self._trace = ProfilerTrace(path, start_step=0, num_steps=1)
+            self._trace.maybe_start(0)
+            self.captures.append(self._trace.log_dir)
+        if self._trace is not None:
+            self._trace.close(sync=sync)
+            self._trace = None
 
 
 def allreduce_p50_us(mesh, axis: str = "tp", nbytes: int = 4 * 1024 * 1024,

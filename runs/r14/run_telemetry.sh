@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-14 live-telemetry session (ISSUE 12): exercise the whole plane
+# on real chips.
+#   1. exported serving loadgen — serve.py --paged with the exporter
+#      (--metrics_port), snapshot mirroring (--rollup_interval), request
+#      tracing, the flight recorder, and size-rotated metrics; mid-run a
+#      backgrounded curl scrapes /metrics.json as the liveness probe.
+#   2. collector pass — scripts/obs_top.py --once tails the run's
+#      metrics chain (rotated generations included) and lands versioned
+#      fleet_rollup events for summarize_run.py.
+#   3. anomaly arm — an impossible interactive deadline forces an ONLINE
+#      SLO-attainment collapse: the flight ring freezes mid-run and
+#      --profile_on_anomaly cross-links a bounded jax.profiler capture
+#      of the decode steps right after it (the dump's 'profile' field).
+#   4. overhead pin — the serving bench line runs traced+exported and
+#      untraced; check_bench_regression gates the traced arm against the
+#      committed trajectory (<= 2% is the acceptance budget).
+# Weights are random inits (telemetry behaviour is value-free);
+# correctness is pinned by CPU tests (tests/test_telemetry.py).
+# Idempotent; reuses the round-5 session helpers.
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r14
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r14 telemetry pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 0. static preflight: layer-1 graftcheck sweep (the lock-discipline rule
+# covers the new exporter/collector threads), report landed for summarize
+step graftcheck 240 python scripts/graftcheck.py --no-trace --json runs/r14/graftcheck.json
+
+# 1. exported + traced serving loadgen on a fixed port, metrics rotated at
+# 1 MiB so the collector follows a real chain; scrape probe rides along
+(sleep 45 && curl -s http://127.0.0.1:9314/metrics.json > runs/r14/scrape_mid_run.json) &
+step servetel 900 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --paged --trace_requests --flight_records --metrics_port 9314 --rollup_interval 1 --metrics_max_mb 1 --num_requests 64 --rate 16 --slots 12 --num_pages 32 --page_size 16 --max_new_tokens 48 --prompt_len_min 8 --prompt_len_max 96 --class_mix interactive=1,standard=2,batch=1 --tenants 3 --log_dir runs/r14/serve_logs
+
+# 2. the collector over the finished run's chain -> fleet_rollup.jsonl
+step rollup 120 python scripts/obs_top.py runs/r14/serve_logs --once --no_clear
+
+# 3. anomaly arm: impossible interactive deadline -> online SLO collapse
+# mid-run -> flight dump + cross-linked jax.profiler capture
+step anomaly 900 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --paged --trace_requests --flight_records --profile_on_anomaly 8 --metrics_port 9315 --rollup_interval 1 --num_requests 48 --rate 32 --slots 8 --num_pages 24 --page_size 16 --max_new_tokens 48 --prompt_len_min 8 --prompt_len_max 96 --slo_classes interactive=0.001,standard=1.0,batch=8.0 --class_mix interactive=3,standard=1 --log_dir runs/r14/anomaly_logs
+
+# 4. overhead pin: traced+exported serving bench vs the committed
+# trajectory through the regression gate (tokens/s within tolerance =
+# the live plane stayed off the hot path)
+bench_line servingtel 1200 --serving --trace_requests --flight_records --metrics_port 9316 --obs_dir runs/r14/bench_obs
+step gate 120 python scripts/check_bench_regression.py --fresh runs/r14/bench_servingtel.json
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r14 telemetry done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""obs_top — live fleet dashboard over the telemetry plane (ISSUE 12).
+
+Tails every process's metrics*.jsonl chain under one or more log dirs
+(and/or scrapes exporter endpoints), folds them into fleet rollups
+(obs/collector.FleetCollector), renders a terminal table per refresh,
+and appends versioned `fleet_rollup` events to a jsonl the post-hoc
+summary can read.
+
+Usage:
+    python scripts/obs_top.py serve_logs                   # live, 2s refresh
+    python scripts/obs_top.py runs/r14/serve_logs --once   # one pass + exit
+    python scripts/obs_top.py logs --endpoint http://127.0.0.1:9100
+    python scripts/obs_top.py logs --rollup_out logs/fleet_rollup.jsonl
+
+Exit status: 0; a missing/empty dir renders as 0 procs (a fleet that has
+not started is a fact, not an error — the summarize_run convention).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("log_dirs", nargs="+",
+                   help="dirs whose metrics*.jsonl chains to tail "
+                        "(recursive; rotated generations followed)")
+    p.add_argument("--endpoint", action="append", default=[],
+                   help="exporter URL to scrape in addition to the tails "
+                        "(repeatable; http://host:port)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh + rollup period, seconds")
+    p.add_argument("--rollup_out", default=None,
+                   help="append fleet_rollup events here (default: "
+                        "<first log dir>/fleet_rollup.jsonl)")
+    p.add_argument("--once", action="store_true",
+                   help="one poll + render + rollup, then exit (staged "
+                        "sessions and tests)")
+    p.add_argument("--no_clear", action="store_true",
+                   help="append frames instead of clearing the screen "
+                        "(tee-able)")
+    return p.parse_args(argv)
+
+
+def _fmt_slo(slo: dict) -> str:
+    if not slo:
+        return "-"
+    return ", ".join(f"{cls} {100 * d['attained']:.0f}% of {d['completed']}"
+                     for cls, d in sorted(slo.items()))
+
+
+def render(collector, rollup: dict) -> str:
+    lines = [
+        f"fleet: {rollup['procs']} proc(s), "
+        f"{rollup['tokens_per_sec']:.0f} tok/s, window "
+        f"{rollup['window_s']:.0f}s",
+        f"SLO attainment: {_fmt_slo(rollup.get('slo_attainment'))}",
+    ]
+    pool = rollup.get("pool")
+    if pool:
+        lines.append(f"pool: {pool['pages_in_use']}/{pool['num_pages']} "
+                     f"pages ({100 * pool['util']:.0f}%)"
+                     + (f", kv util {pool['kv_util_mean']}"
+                        if pool.get("kv_util_mean") is not None else ""))
+    skew = rollup.get("rank_skew")
+    if skew and skew["suspects"]:
+        s = skew["suspects"][0]
+        lines.append(f"skew: worst p{s['process']} in {s['phase']} "
+                     f"(+{s['excess_s']:.2f}s over mean)"
+                     + (f"; PERSISTENT: "
+                        f"{', '.join('p%d' % x for x in skew['persistent'])}"
+                        if skew["persistent"] else ""))
+    lines.append("| source | tok/s | live | queue | pages | slo |")
+    lines.append("|---|---|---|---|---|---|")
+    for key, state in sorted(collector.procs.items()):
+        snap = state.get("telemetry_snapshot")
+        if snap is None:
+            lines.append(f"| {os.path.basename(key)} | (no snapshot yet; "
+                         f"post-hoc events only) | | | | |")
+            continue
+        g = snap.get("gauges", {})
+        tps = g.get("serve/tokens_per_sec",
+                    g.get("train/tokens_per_sec", 0.0))
+        slo = ", ".join(
+            f"{n.split('/')[1]} {100 * v:.0f}%"
+            for n, v in sorted(g.items())
+            if n.startswith("slo/") and n.endswith("/attained")) or "-"
+        lines.append(
+            f"| {os.path.basename(key)} | {tps:.0f} "
+            f"| {g.get('serve/live', g.get('train/step', 0)):.0f} "
+            f"| {g.get('serve/queue_depth', 0):.0f} "
+            f"| {g.get('serve/pages_in_use', 0):.0f}"
+            f"/{g.get('serve/num_pages', 0):.0f} | {slo} |")
+    tails = sum(t.records for t in collector._tailers.values())
+    invalid = sum(t.invalid for t in collector._tailers.values())
+    lines.append(f"({tails} records folded"
+                 + (f", {invalid} invalid/drifted" if invalid else "")
+                 + (f", {collector.scrape_errors} scrape errors"
+                    if collector.scrape_errors else "") + ")")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    sys.path.insert(0, REPO)
+    from distributed_pytorch_from_scratch_tpu.obs.collector import (
+        FleetCollector)
+
+    out = args.rollup_out or os.path.join(args.log_dirs[0],
+                                          "fleet_rollup.jsonl")
+    collector = FleetCollector(args.log_dirs, endpoints=args.endpoint,
+                               out_path=out)
+    try:
+        while True:
+            collector.poll()
+            rollup = collector.emit()
+            frame = render(collector, rollup)
+            if not args.no_clear and not args.once:
+                print("\033[2J\033[H", end="")
+            print(frame, flush=True)
+            if args.once:
+                print(f"rollup appended to {out}")
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        print(f"\nrollups appended to {out} "
+              f"({collector.rollups} emitted)")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -275,6 +275,80 @@ def request_lines(rdir):
     return rows
 
 
+def crossproc_lines(rdir):
+    """Cross-process request waterfalls (ISSUE 12): `request_trace`
+    events sharing one trace id but retired in DIFFERENT processes merge
+    into a single contiguous waterfall after clock-offset translation
+    (obs/reqtrace.merge_traces) — the router -> prefill -> decode view
+    the fleet needs."""
+    by_tid = {}
+    for _, rec in _iter_events(rdir, ("request_trace",)):
+        by_tid.setdefault(rec.get("trace_id"), []).append(rec)
+    groups = [(tid, recs) for tid, recs in sorted(by_tid.items())
+              if tid is not None and
+              len({r.get("process", 0) for r in recs}) > 1]
+    if not groups:
+        return []
+    try:
+        import sys
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from distributed_pytorch_from_scratch_tpu.obs.reqtrace import (
+            merge_traces)
+    except ImportError as e:
+        return [f"(cross-process request_trace events present but "
+                f"reqtrace import failed: {e})"]
+    rows = []
+    for tid, recs in groups:
+        m = merge_traces(recs)
+        hops = " -> ".join(f"p{p}" for p in m["processes"])
+        rows.append(f"- trace `{tid}` across {hops} "
+                    f"({m['records']} records, {m['generated']} tokens, "
+                    f"{m['total_ms']}ms total): "
+                    f"{_fmt_timeline(m['spans'])}")
+    return rows
+
+
+def fleet_lines(rdir):
+    """`fleet_rollup` events (obs/collector.py via scripts/obs_top.py):
+    the fleet-level view a live collector computed during the run."""
+    rows = []
+    for p in sorted(glob.glob(os.path.join(rdir, "**",
+                                           "fleet_rollup*.jsonl"),
+                              recursive=True)):
+        rel = os.path.relpath(p, rdir)
+        last = None
+        count = 0
+        for line in open(p, errors="replace"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("tag") == "fleet_rollup":
+                last, count = rec, count + 1
+        if last is None:
+            continue
+        slo = ", ".join(
+            f"{cls} {100 * d.get('attained', 0):.0f}% of "
+            f"{d.get('completed')}"
+            for cls, d in sorted((last.get("slo_attainment") or {}).items()))
+        line = (f"- `{rel}` ({count} rollups): {last.get('procs')} proc(s), "
+                f"{last.get('tokens_per_sec')} tok/s fleet"
+                + (f"; SLO {slo}" if slo else ""))
+        pool = last.get("pool")
+        if pool:
+            line += (f"; pool {pool.get('pages_in_use')}/"
+                     f"{pool.get('num_pages')} pages "
+                     f"({100 * pool.get('util', 0):.0f}%)")
+        if last.get("rank_skew", {}).get("persistent"):
+            line += (f"; PERSISTENT skew: "
+                     + ", ".join(f"p{x}" for x in
+                                 last["rank_skew"]["persistent"]))
+        rows.append(line)
+    return rows
+
+
 def flight_lines(rdir):
     """Pointers to anomaly flight dumps (obs/flight.py) under the runs
     dir, with their trigger — the post-mortem starts HERE, not in
@@ -293,7 +367,9 @@ def flight_lines(rdir):
                         + (f" — victim rid {trig['victim_rid']}"
                            if "victim_rid" in trig else "")
                         + (f" — {trig['reason']}"
-                           if "reason" in trig else ""))
+                           if "reason" in trig else "")
+                        + (f" — device profile: {doc['profile']}"
+                           if doc.get("profile") else ""))
         except (ValueError, OSError) as e:
             rows.append(f"- `{rel}`: unparseable ({e})")
     return rows
@@ -456,6 +532,17 @@ def summarize(rdir):
         out.append("")
         out.append("Slowest requests (per-request span waterfall):")
         out.extend(waterfalls)
+    crossproc = crossproc_lines(rdir)
+    if crossproc:
+        out.append("")
+        out.append("Cross-process request waterfalls (merged after "
+                   "clock-offset translation):")
+        out.extend(crossproc)
+    fleet = fleet_lines(rdir)
+    if fleet:
+        out.append("")
+        out.append("Fleet rollups (live collector, scripts/obs_top.py):")
+        out.extend(fleet)
     flights = flight_lines(rdir)
     if flights:
         out.append("")

@@ -158,6 +158,23 @@ CORE_LANE = {
     # compile), the flight ring bound + PoolExhausted dump pin, the
     # regression-gate trio, the schema-drift guard, the rank-skew unit,
     # and the traced-serve CLI rot guard
+    # obs v3 (ISSUE 12): the exporter endpoint + busy-port refusal, the
+    # rotation chain + torn-line resync (the collector's correctness
+    # core), fleet rollup math vs hand computation, the cross-process
+    # waterfall acceptance pin, the anomaly->profiler cross-link, and the
+    # telemetry serve CLI rot guard; the train smoke (slow lane) and the
+    # overhead pin (timing-sensitive) stay out of core
+    "test_telemetry.py": [
+        "test_exporter_endpoint_json_and_prometheus",
+        "test_exporter_busy_port_refuses_loudly",
+        "test_metrics_rotation_chains_through_schema_valid_events",
+        "test_tailer_holds_torn_line_and_resyncs",
+        "test_fleet_rollup_matches_hand_computed_attainment",
+        "test_crossproc_waterfall_merges_with_deliberate_clock_offset",
+        "test_anomaly_dump_cross_links_profiler_capture",
+        "test_serve_dry_run_with_telemetry_and_profiler",
+        "test_bench_telemetry_flags_gated_on_serving",
+    ],
     "test_obs_v2.py": [
         "test_paged_request_timelines_contiguous_and_sum_to_wall",
         "test_flight_ring_bound_holds_under_sustained_load",

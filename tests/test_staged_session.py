@@ -27,7 +27,9 @@ R5 = os.path.join(REPO, "runs", "r5")
 # serving-v2 sweep + slot-vs-paged A/B, r10 the speculative k-sweep +
 # fused-sampler ablation, r11 the int8 wire sweep + int8-KV serving arms,
 # r12 the ZeRO stage x wire ladder + RS/AG breakdown arm, r13 the
-# regression-gated trajectory point + traced/flight-recorded serving)
+# regression-gated trajectory point + traced/flight-recorded serving,
+# r14 the live telemetry plane: exported serving + collector rollup +
+# the SLO-collapse anomaly arm with cross-linked device profiling)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -35,7 +37,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r10"),
                             os.path.join(REPO, "runs", "r11"),
                             os.path.join(REPO, "runs", "r12"),
-                            os.path.join(REPO, "runs", "r13"))
+                            os.path.join(REPO, "runs", "r13"),
+                            os.path.join(REPO, "runs", "r14"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
@@ -179,7 +182,7 @@ def validate(argv):
     if prog.startswith("scripts/") and prog.endswith(".py"):
         name = os.path.basename(prog)[:-3]
         if name in ("tpu_checks", "make_image_corpus", "tune_flash_blocks",
-                    "check_bench_regression", "graftcheck"):
+                    "check_bench_regression", "graftcheck", "obs_top"):
             mod = _load_script(name)
             return _parse_with(mod.parse_args, rest)
         if name == "run_step":
