@@ -195,6 +195,17 @@ def parse_args(argv=None):
                         "the paged/speculative arms (dequant-on-use "
                         "inside the decode/prefill programs; "
                         "ops/quant.quantize_decode_params)")
+    p.add_argument("--paged_attn", default="gather",
+                   choices=["gather", "pallas"],
+                   help="--serving: the paged arms' attend impl. "
+                        "'pallas' walks the page table in place "
+                        "(ops/pallas/paged_attention.py) AND adds a "
+                        "gather-impl arm at the SAME page-byte budget, "
+                        "so the record carries the A/B "
+                        "(pallas_vs_gather, both TTFT/TPOT p95) plus "
+                        "attribution's decode HBM bytes/step before and "
+                        "after the gather copy. Non-TPU backends fall "
+                        "back to gather with a one-time warning")
     p.add_argument("--trace_requests", action="store_true",
                    help="--serving: per-request span timelines on the "
                         "paged arm (obs/reqtrace.py) — request_trace "
@@ -238,6 +249,9 @@ def parse_args(argv=None):
         p.error("--speculate is a --serving mode")
     if args.kv_dtype != "native" and not args.serving:
         p.error("--kv_dtype is a --serving knob (the paged KV pool)")
+    if args.paged_attn != "gather" and not args.serving:
+        p.error("--paged_attn is a --serving knob (the paged engine's "
+                "attend impl; training has no page table)")
     if (args.trace_requests or args.flight_records) and not args.serving:
         p.error("--trace_requests/--flight_records are --serving knobs "
                 "(training runs get them from train.py's observer)")
@@ -607,9 +621,13 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             buf_len=buf_len, eos_id=eos, page_size=args.page_size,
             num_pages=num_pages, prefill_chunk=args.prefill_chunk,
             kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
+            paged_attn_impl=args.paged_attn,
             tracer=obs_tracer, writer=obs_writer,
             request_tracer=obs_rt, flight=obs_flight,
             telemetry=obs_telemetry)
+        # the impl the engine actually built (a non-TPU backend downgrades
+        # 'pallas' to 'gather' with a warning — the record must not lie)
+        paged_attn = paged.paged_attn_impl
         paged_summary = run_loadgen(paged, burst())
         paged_rate = paged_summary["tokens_per_sec"]
     finally:
@@ -624,6 +642,46 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             obs_tracer.close()
         if obs_writer is not None:
             obs_writer.close()
+
+    # (a'') the gather-impl arm of the kernel A/B (ISSUE 14): when
+    # --paged_attn pallas was asked for, rerun the SAME burst through an
+    # otherwise-identical engine on the gather impl at the SAME page-byte
+    # budget, and price both impls' decode dispatch analytically
+    # (obs/attribution.paged_decode_hbm_bytes) so the record carries the
+    # gather-copy elimination as numbers, not claims. On a fallen-back
+    # backend both arms resolve to gather — the ratio prints ~1.0 and the
+    # record says so via `paged_attn`.
+    from distributed_pytorch_from_scratch_tpu.obs.attribution import (
+        paged_decode_hbm_bytes)
+    max_pages_per_slot = -(-buf_len // args.page_size)
+    hbm_kw = dict(slots=args.serve_requests,
+                  max_pages=max_pages_per_slot, page_size=args.page_size,
+                  kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
+                  live_tokens=args.serve_requests * (plen + gen // 2))
+    decode_hbm = {impl: paged_decode_hbm_bytes(cfg, paged_attn=impl,
+                                               **hbm_kw)
+                  for impl in ("gather", "pallas")}
+    gather_summary = None
+    if args.paged_attn == "pallas":
+        # the gather arm runs WITHOUT the obs hooks (those closed with
+        # the paged arm above, whose record they annotate) — so when obs
+        # flags are combined with the A/B, the pallas arm alone pays the
+        # tracing cost and the ratio is not a clean kernel comparison;
+        # say so rather than let the skew pass as a kernel result (the
+        # staged r15 A/B lines run obs-free for exactly this reason)
+        if obs_tracer is not None or obs_writer is not None:
+            print("bench[serving]: NOTE pallas_vs_gather includes "
+                  "observability overhead on the pallas arm only "
+                  "(--trace_requests/--flight_records/--metrics_port "
+                  "attach to the headline arm); rerun without obs flags "
+                  "for a clean kernel A/B", file=sys.stderr)
+        gather_eng = PagedEngine(
+            model, mesh, params, num_slots=args.serve_requests,
+            buf_len=buf_len, eos_id=eos, page_size=args.page_size,
+            num_pages=num_pages, prefill_chunk=args.prefill_chunk,
+            kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
+            paged_attn_impl="gather")
+        gather_summary = run_loadgen(gather_eng, burst())
 
     # (a') the speculative arm at the SAME byte budget: the drafter's pages
     # buy acceptance, not capacity, so they are paid for by SHRINKING the
@@ -666,7 +724,8 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             num_slots=args.serve_requests, buf_len=buf_len, eos_id=eos,
             speculate_k=k, drafter_pages=d_pages, page_size=ps,
             num_pages=t_pages, prefill_chunk=args.prefill_chunk,
-            kv_dtype=kv_dtype, decode_weight_dtype=wdtype)
+            kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
+            paged_attn_impl=args.paged_attn)
         spec_summary = run_loadgen(spec, burst())
 
     # (b) the PR 5 slot engine
@@ -695,6 +754,19 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     oneshot_rate = oneshot_tokens / max(oneshot_s, 1e-9)
 
     fmt = lambda v: "-" if v is None else f"{v:.0f}"
+    kernel_line = ""
+    if gather_summary is not None:
+        kernel_line = (
+            f" vs GATHER impl {gather_summary['tokens_per_sec']:.0f} "
+            f"tok/s (TTFT p95 {fmt(gather_summary['ttft_ms_p95'])}ms)")
+    hbm_g, hbm_p = decode_hbm["gather"], decode_hbm["pallas"]
+    saved_pct = (1 - hbm_p["total_bytes"]
+                 / max(hbm_g["total_bytes"], 1)) * 100
+    print(f"bench[serving]: decode HBM bytes/step — gather "
+          f"{hbm_g['total_bytes']/1e6:.1f} MB (gather copy "
+          f"{hbm_g['gather_copy_bytes']/1e6:.1f} MB) vs pallas "
+          f"{hbm_p['total_bytes']/1e6:.1f} MB ({saved_pct:.0f}% "
+          f"eliminated; running impl: {paged_attn})", file=sys.stderr)
     spec_line = ""
     if spec_summary is not None:
         spec_line = (
@@ -714,7 +786,8 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
           f"{paged_summary['max_live']}, kv util "
           f"{paged_summary['kv_util_mean']:.2f}, prefix hits "
           f"{100 * paged_summary['prefix_hit_rate']:.0f}%, "
-          f"{paged_summary['preemptions']} preempted)" + spec_line +
+          f"{paged_summary['preemptions']} preempted)" + spec_line
+          + kernel_line +
           f" vs slot "
           f"{serve_rate:.0f} tok/s (TTFT p95 "
           f"{fmt(summary['ttft_ms_p95'])}ms, {args.slots} slots) vs "
@@ -751,7 +824,9 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
                       f"drafter pages inside the budget) over "
                       if args.speculate else "")
                    + f"PAGED at {num_pages}x{args.page_size}-token pages = "
-                   f"slots{args.slots} HBM, {args.serve_requests}-request "
+                   + (f"{paged_attn} attn, " if paged_attn != "gather"
+                      else "")
+                   + f"slots{args.slots} HBM, {args.serve_requests}-request "
                    f"long/short burst, prompt {max(3, plen // 4)}/{plen}, "
                    f"gen {gen}; vs_baseline = speedup over one-shot "
                    f"b{args.slots} GreedyDecoder batches; paged_vs_slot = "
@@ -770,6 +845,25 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         "decode_weight_dtype": args.decode_weight_dtype,
         "num_pages": num_pages,
         "kv_capacity_ratio": kv_capacity_ratio,
+        # paged-attention kernel A/B (ISSUE 14): the impl that actually
+        # ran, the analytic decode-dispatch HBM bytes for BOTH impls
+        # (obs/attribution.paged_decode_hbm_bytes — the gather-copy
+        # elimination as an asserted number), and, when the pallas arm
+        # ran, the gather arm at the same budget. The regression gate
+        # treats decode_hbm_bytes_per_step directionally (up = fail).
+        "paged_attn": paged_attn,
+        "decode_hbm_bytes_per_step": decode_hbm[paged_attn]["total_bytes"],
+        "decode_hbm_bytes_gather": decode_hbm["gather"]["total_bytes"],
+        "decode_hbm_bytes_pallas": decode_hbm["pallas"]["total_bytes"],
+        "gather_copy_bytes_per_step":
+            decode_hbm["gather"]["gather_copy_bytes"],
+        **({"pallas_vs_gather": round(
+                paged_rate / max(gather_summary["tokens_per_sec"], 1e-9),
+                3),
+            "gather_rate": round(gather_summary["tokens_per_sec"], 1),
+            "gather_ttft_ms_p95": gather_summary["ttft_ms_p95"],
+            "gather_tpot_ms_p95": gather_summary["tpot_ms_p95"]}
+           if gather_summary is not None else {}),
         # ISSUE 10: where the per-request timelines / flight dumps landed
         **({"obs_dir": args.obs_dir}
            if (args.trace_requests or args.flight_records

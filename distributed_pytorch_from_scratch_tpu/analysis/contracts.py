@@ -414,35 +414,41 @@ def run_trace_contracts(full: bool = False) -> List[dict]:
                "the compressed wire silently does not apply",
         "train_step_zero3_int8"))
 
-    # serving: paged decode donation + inventory-free checks
-    dec = P.paged_decode_program()
-    results.append(check_donation_aliased(dec))
-    exp = expected_collectives(**dec.config)
-    results.append(check_collective_inventory(dec, exp))
-
-    # recompile probe: decode step lowered from different host states
-    results.append(check_stable_lowering(
-        "paged_decode", _decode_lowerings()))
+    # serving: paged decode donation + inventory-free checks, for BOTH
+    # attend impls — the pallas kernel (ISSUE 14) must add NO collective
+    # the priced schedule doesn't name, and its donation must survive
+    for impl in ("gather", "pallas"):
+        dec = P.paged_decode_program(paged_attn=impl)
+        results.append(check_donation_aliased(dec))
+        exp = expected_collectives(**dec.config)
+        results.append(check_collective_inventory(dec, exp))
+        # recompile probe: decode step lowered from different host states
+        # (the pallas page walk reads the table through scalar prefetch —
+        # a table VALUE baked into the kernel would recompile per step)
+        results.append(check_stable_lowering(
+            "paged_decode" + ("" if impl == "gather" else f"_{impl}"),
+            _decode_lowerings(paged_attn=impl)))
 
     if full:
-        chunk = P.prefill_chunk_program()
-        results.append(check_donation_aliased(chunk))
-        results.append(check_collective_inventory(
-            chunk, expected_collectives(**chunk.config)))
-        ver = P.speculative_verify_program()
-        results.append(check_donation_aliased(ver))
-        results.append(check_collective_inventory(
-            ver, expected_collectives(**ver.config)))
+        for impl in ("gather", "pallas"):
+            chunk = P.prefill_chunk_program(paged_attn=impl)
+            results.append(check_donation_aliased(chunk))
+            results.append(check_collective_inventory(
+                chunk, expected_collectives(**chunk.config)))
+            ver = P.speculative_verify_program(paged_attn=impl)
+            results.append(check_donation_aliased(ver))
+            results.append(check_collective_inventory(
+                ver, expected_collectives(**ver.config)))
     return results
 
 
-def _decode_lowerings() -> List[str]:
+def _decode_lowerings(paged_attn: str = "gather") -> List[str]:
     """The paged decode step lowered from 3 different host states (step
     index, cursor positions, table contents) — shapes identical."""
     import jax.numpy as jnp
 
     from . import programs as P
-    eng = P._paged_engine(2)
+    eng = P._paged_engine(2, paged_attn=paged_attn)
     texts = []
     for bump in (0, 1, 3):
         tokens = jnp.asarray(eng._tokens) + bump

@@ -139,8 +139,9 @@ def train_step_refuses(zero_stage: int, wire: str,
     return None
 
 
-@functools.lru_cache(maxsize=4)
-def _paged_engine(tp: int = 2, speculative: bool = False):
+@functools.lru_cache(maxsize=8)
+def _paged_engine(tp: int = 2, speculative: bool = False,
+                  paged_attn: str = "gather"):
     import jax
 
     from ..config import MeshConfig
@@ -153,6 +154,11 @@ def _paged_engine(tp: int = 2, speculative: bool = False):
     model = Transformer(cfg, tp_size=tp)
     params = jax.device_put(model.init(jax.random.key(7)),
                             model.shardings(mesh))
+    # the pallas variant lowers through the Pallas interpreter on the
+    # CPU contract mesh (the engines' explicit opt-in), so the kernel
+    # path's wire and donation facts are checkable chip-free
+    kw = dict(paged_attn_impl=paged_attn,
+              paged_attn_interpret=paged_attn == "pallas")
     if speculative:
         from ..serving.speculative import SpeculativeEngine
         dmodel = Transformer(cfg, tp_size=tp)
@@ -161,9 +167,9 @@ def _paged_engine(tp: int = 2, speculative: bool = False):
         return SpeculativeEngine(model, mesh, params, dmodel, dparams,
                                  num_slots=2, buf_len=32, eos_id=1,
                                  speculate_k=2, page_size=8,
-                                 prefill_chunk=4)
+                                 prefill_chunk=4, **kw)
     return PagedEngine(model, mesh, params, num_slots=2, buf_len=32,
-                       eos_id=1, page_size=8, prefill_chunk=4)
+                       eos_id=1, page_size=8, prefill_chunk=4, **kw)
 
 
 def _engine_step_args(eng):
@@ -183,21 +189,25 @@ def _finish(name, eng, fn, args, donate_argnums, config) -> Program:
                    donated_flat_stop=stop, config=config)
 
 
-@functools.lru_cache(maxsize=4)
-def paged_decode_program(tp: int = 2) -> Program:
+@functools.lru_cache(maxsize=8)
+def paged_decode_program(tp: int = 2, paged_attn: str = "gather") -> Program:
     """The paged decode step exactly as PagedEngine compiles it (donated
-    KV pool halves, per-row cursors over the page table)."""
-    eng = _paged_engine(tp)
+    KV pool halves, per-row cursors over the page table). `paged_attn`
+    selects the attend impl — the 'pallas' variant must satisfy the SAME
+    collective schedule (the kernel changes HBM traffic, never the wire)."""
+    eng = _paged_engine(tp, paged_attn=paged_attn)
     cfg = dict(serving=True, tp=tp, dp=1, kind="decode")
-    return _finish(f"paged_decode_tp{tp}", eng, eng._step_fn,
+    suffix = "" if paged_attn == "gather" else f"_{paged_attn}"
+    return _finish(f"paged_decode_tp{tp}{suffix}", eng, eng._step_fn,
                    _engine_step_args(eng), (1, 2), cfg)
 
 
-@functools.lru_cache(maxsize=4)
-def prefill_chunk_program(tp: int = 2, cw: int = 4) -> Program:
+@functools.lru_cache(maxsize=8)
+def prefill_chunk_program(tp: int = 2, cw: int = 4,
+                          paged_attn: str = "gather") -> Program:
     """One chunked-prefill dispatch (width cw) from the paged engine."""
     import jax.numpy as jnp
-    eng = _paged_engine(tp)
+    eng = _paged_engine(tp, paged_attn=paged_attn)
     fn = eng._build_chunk(cw)
     n = eng.num_slots
     args = (eng._params_in, eng.pool.ks, eng.pool.vs,
@@ -206,16 +216,18 @@ def prefill_chunk_program(tp: int = 2, cw: int = 4) -> Program:
             jnp.zeros((n, cw), jnp.int32), jnp.zeros((n, cw), jnp.int32),
             jnp.asarray(eng._seeds))
     cfg = dict(serving=True, tp=tp, dp=1, kind="prefill_chunk")
-    return _finish(f"prefill_chunk_tp{tp}_w{cw}", eng, fn, args, (1, 2),
-                   cfg)
+    suffix = "" if paged_attn == "gather" else f"_{paged_attn}"
+    return _finish(f"prefill_chunk_tp{tp}_w{cw}{suffix}", eng, fn, args,
+                   (1, 2), cfg)
 
 
-@functools.lru_cache(maxsize=4)
-def speculative_verify_program(tp: int = 2, k: int = 2) -> Program:
+@functools.lru_cache(maxsize=8)
+def speculative_verify_program(tp: int = 2, k: int = 2,
+                               paged_attn: str = "gather") -> Program:
     """The speculative engine's K+1 verify dispatch (target scores k+1
     positions through the page table in one program)."""
     import jax.numpy as jnp
-    eng = _paged_engine(tp, speculative=True)
+    eng = _paged_engine(tp, speculative=True, paged_attn=paged_attn)
     fn = eng._verify_fn
     n = eng.num_slots
     w = k + 1
@@ -231,7 +243,9 @@ def speculative_verify_program(tp: int = 2, k: int = 2) -> Program:
             jnp.zeros((n, w), jnp.int32), jnp.zeros((n, w), jnp.int32),
             jnp.asarray(eng._seeds))
     cfg = dict(serving=True, tp=tp, dp=1, kind="spec_verify")
-    return _finish(f"spec_verify_tp{tp}_k{k}", eng, fn, args, (1, 2), cfg)
+    suffix = "" if paged_attn == "gather" else f"_{paged_attn}"
+    return _finish(f"spec_verify_tp{tp}_k{k}{suffix}", eng, fn, args,
+                   (1, 2), cfg)
 
 
 def clear_caches() -> None:

@@ -331,7 +331,13 @@ def _gather_page_view(cache, page_tbl: jax.Array, dtype) -> jax.Array:
 
     A quantized pool (codes, scales) dequantizes INSIDE the gather — the
     attend math downstream is byte-for-byte the same einsum block, only
-    the view operand changed (kv_dtype='int8', ISSUE 8)."""
+    the view operand changed (kv_dtype='int8', ISSUE 8).
+
+    This materialized copy is the paged decode path's HBM floor, and
+    since ISSUE 14 it is the ORACLE impl (`paged_attn_impl='gather'`):
+    `ops.pallas.paged_attention` attends over the paged layout in place
+    — same tokens, no dense view — and is what a TPU serving config
+    should run (`--paged_attn pallas`)."""
     b, mp = page_tbl.shape
     if isinstance(cache, tuple):
         codes, sc = cache
@@ -346,14 +352,24 @@ def _gather_page_view(cache, page_tbl: jax.Array, dtype) -> jax.Array:
 
 def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
                       token: jax.Array, cur: jax.Array, page_tbl: jax.Array,
-                      page_size: int, cos_t, sin_t, dtype):
+                      page_size: int, cos_t, sin_t, dtype,
+                      attn_impl: str = "gather",
+                      attn_interpret: bool = False):
     """`_decode_one` through a page table: one single-token step where each
     row's K/V write lands in the PAGE mapped for its cursor position
-    (pool.at[page, :, offset, :]) and the attention reads the dense view
-    gathered from the row's page list. The attend math (grouped einsum,
-    MASK_VALUE mask, f32 scores) is the same block `_decode_one` lowers, so
-    at equal logical buffer length the paged step is value-identical to the
-    slot-granular step over the same written K/V.
+    (pool.at[page, :, offset, :]) and the attention reads the row's page
+    list. Two attend impls, token-identical by contract:
+
+    * `attn_impl='gather'` (the oracle): materialize the dense logical
+      view (`_gather_page_view`) and run the same einsum block
+      `_decode_one` lowers — MASK_VALUE mask, f32 scores.
+    * `attn_impl='pallas'` (ISSUE 14): `ops.pallas.paged_attention` walks
+      the page table in place — per-row cursor masking, online softmax
+      across page blocks, int8 dequant fused into the block loop — so the
+      per-step HBM copy of every slot's whole context never happens.
+      `attn_interpret` runs the kernel under the Pallas interpreter (the
+      CPU identity tests); callers resolve the impl up front via
+      `ops.pallas.paged_attention.resolve_paged_attn_impl`.
 
     pool_k/pool_v: (L, num_pages+1, kvh, page_size, hd); page_tbl:
     (b, max_pages) int32 page ids (free rows map every entry at the scratch
@@ -390,6 +406,15 @@ def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
             q, k = apply_rotary(q, k, cos, sin)
         k_cache = write_cache(k_cache, k)
         v_cache = write_cache(v_cache, v)
+        if attn_impl == "pallas":
+            # walk the page table in place (writes above land in the pool
+            # first, so the pending token is visible like the gather path)
+            from ..ops.pallas.paged_attention import paged_attention
+            o = paged_attention(q, k_cache, v_cache, page_tbl, cur,
+                                page_size=page_size,
+                                interpret=attn_interpret).astype(dtype)
+            x = _finish_block(model, lp, x, o, dtype)
+            return x, (k_cache, v_cache)
         k_view = _gather_page_view(k_cache, page_tbl, dtype)
         v_view = _gather_page_view(v_cache, page_tbl, dtype)
         # identical attend block to _decode_one (same einsums, same mask,
@@ -417,7 +442,9 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
                          qlen: jax.Array, page_tbl: jax.Array,
                          dst_page: jax.Array, dst_off: jax.Array,
                          page_size: int, cos_t, sin_t, dtype,
-                         all_logits: bool = False):
+                         all_logits: bool = False,
+                         attn_impl: str = "gather",
+                         attn_interpret: bool = False):
     """One CHUNK of an incremental prefill: process `chunk` (b, cw) tokens
     occupying absolute positions start..start+qlen-1 (columns >= qlen are
     pad), write their K/V into the pages `dst_page`/`dst_off` (b, cw) map
@@ -469,6 +496,17 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
             q, k = apply_rotary(q, k, cos, sin)
         k_cache = write_cache(k_cache, k)
         v_cache = write_cache(v_cache, v)
+        if attn_impl == "pallas":
+            # the chunk's own K/V are in the pool (writes above), so the
+            # kernel's start+i causality reproduces `visible` exactly;
+            # pad columns (>= qlen) stay garbage-into-garbage like the
+            # gather path, and their page walk is skipped
+            from ..ops.pallas.paged_attention import paged_attention
+            o = paged_attention(q, k_cache, v_cache, page_tbl, start,
+                                page_size=page_size, qlen=qlen,
+                                interpret=attn_interpret).astype(dtype)
+            x = _finish_block(model, lp, x, o, dtype)
+            return x, (k_cache, v_cache)
         k_view = _gather_page_view(k_cache, page_tbl, dtype)
         v_view = _gather_page_view(v_cache, page_tbl, dtype)
         kvh = model.num_local_kv_heads

@@ -604,6 +604,71 @@ def format_attribution(report: Dict,
     return "\n".join(lines)
 
 
+# -- paged-decode roofline (ISSUE 14) ------------------------------------
+
+def paged_decode_hbm_bytes(cfg, slots: int, max_pages: int, page_size: int,
+                           kv_dtype=None, paged_attn: str = "gather",
+                           decode_weight_dtype=None,
+                           live_tokens: Optional[int] = None) -> Dict:
+    """Analytic HBM bytes ONE paged decode dispatch moves, itemised so the
+    gather-vs-pallas A/B can assert the win instead of claiming it.
+
+    The decode step at serving scale is bytes-bound; per dispatch it must
+    move (a) the weights (int8 when `decode_weight_dtype='int8'` — the PR
+    8 floor) and (b) the K/V context. How (b) is priced depends on the
+    attend impl:
+
+    * `'gather'` — `_gather_page_view` materializes the dense logical
+      view per layer: the pool pages are READ (at their storage dtype),
+      the dequantized compute-dtype view is WRITTEN to HBM, and the
+      attend READS it back. The write+read of that view is
+      `gather_copy_bytes` — pure overhead the kernel exists to kill —
+      and the view spans the FULL (slots, max_pages*page_size) dense
+      shape whatever the cursors say (the gather cannot skip).
+    * `'pallas'` — the kernel streams pages pool->VMEM once;
+      `gather_copy_bytes` is exactly 0, and the cursor-mask block skip
+      bounds the pool read by the LIVE context (`live_tokens`, page-
+      rounded) instead of the dense span.
+
+    Returns {weight_bytes, kv_pool_read_bytes, gather_copy_bytes,
+    total_bytes, paged_attn}: `total = weight + pool_read + gather_copy`,
+    so `total(gather) - total(pallas)` at equal live context is the
+    gather-copy elimination plus the dead-page skip."""
+    if paged_attn not in ("gather", "pallas"):
+        raise ValueError(f"paged_attn must be 'gather'/'pallas', got "
+                         f"{paged_attn!r}")
+    L, kvh, hd = cfg.num_layers, cfg.kv_heads, cfg.head_dim
+    compute_itemsize = 2 if "bf16" in str(cfg.compute_dtype) or (
+        "bfloat16" in str(cfg.compute_dtype)) else 4
+    # stored bytes per token position (K+V, all layers): int8 pages carry
+    # codes + one f32 scale per head-vector (kv_manager.kv_token_bytes)
+    if kv_dtype in ("int8", "s8"):
+        stored_per_tok = 2 * L * kvh * (hd + 4)
+    else:
+        stored_per_tok = 2 * L * kvh * hd * compute_itemsize
+    view_per_tok = 2 * L * kvh * hd * compute_itemsize  # dequantized view
+    dense_span = slots * max_pages * page_size
+    if paged_attn == "gather" or live_tokens is None:
+        read_span = dense_span
+    else:
+        # block-granular skip: live context rounds up to whole pages
+        read_span = min(dense_span,
+                        -(-int(live_tokens) // page_size) * page_size)
+    weight_itemsize = 1 if decode_weight_dtype in ("int8", "s8") else (
+        compute_itemsize)
+    weight_bytes = cfg.num_params() * weight_itemsize
+    pool_read = read_span * stored_per_tok
+    gather_copy = 2 * dense_span * view_per_tok if paged_attn == "gather" \
+        else 0
+    return {
+        "paged_attn": paged_attn,
+        "weight_bytes": int(weight_bytes),
+        "kv_pool_read_bytes": int(pool_read),
+        "gather_copy_bytes": int(gather_copy),
+        "total_bytes": int(weight_bytes + pool_read + gather_copy),
+    }
+
+
 # -- checkable collective schedule (ISSUE 11) ----------------------------
 
 def expected_collectives(tp: int = 1, sp: bool = False,
@@ -735,9 +800,13 @@ def expected_collectives(tp: int = 1, sp: bool = False,
         # inference programs: row-parallel psums on tp; gathers allowed
         # (vocab-parallel logits, page views); nothing on dp. All
         # serving kinds (decode / prefill_chunk / spec_verify) share one
-        # schedule today — when their wires diverge (e.g. a Pallas
-        # decode kernel drops the gather), differentiate on `kind` HERE
-        # so the contract tightens with the implementation.
+        # schedule for BOTH paged-attention impls: the Pallas kernel
+        # (ISSUE 14) changes only local HBM traffic, never the wire —
+        # graftcheck's collective-inventory contract asserts the pallas
+        # programs against this same schedule, so a kernel revision that
+        # grew a collective would fail there. When the wires genuinely
+        # diverge some day, differentiate on `kind` HERE so the contract
+        # tightens with the implementation.
         require[("tp", "all-reduce")] = {
             "dtypes": wide | {"s32", "u32"},
             "note": f"row-parallel output psums + fused-sampler argmax "

@@ -1,0 +1,67 @@
+"""Shared JSON persistence for the Pallas block-autotuner tables.
+
+Both kernel families keep a small in-memory table of tuned block shapes
+— flash (`flash_attention.py`: (t_bucket, head_dim, dtype, backend) ->
+BlockConfig) and paged (`paged_attention.py`: (page_size, head_dim,
+kv_dtype, backend) -> PagedBlockConfig) — persisted as JSON so one
+on-chip sweep serves every later run. The env-var/merge/atomic-publish
+mechanics are identical and MUST NOT drift independently (a key-format
+drift between writer and reader silently un-tunes every dispatch), so
+they live here once: keys serialize as ':'-joined parts, values as the
+config's tuple, unreadable/garbled files are ignored (the table keeps
+its defaults), and writes publish atomically via os.replace (the
+training/checkpoint.py convention).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Tuple
+
+
+def default_cache_path(env_var: str, filename: str) -> str:
+    return os.environ.get(
+        env_var,
+        os.path.join(os.path.expanduser("~"), ".cache", "dpfs_tpu",
+                     filename))
+
+
+def load_json_table(path: str, table: Dict, parse_key: Callable,
+                    parse_cfg: Callable) -> int:
+    """Merge `path`'s JSON into `table`; returns entries read. `parse_key`
+    maps the split ':' parts to a table key, `parse_cfg` the stored list
+    to a config — either raising ValueError/TypeError skips just that
+    entry. Unreadable/garbled files are ignored entirely."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    n = 0
+    for key, blocks in raw.items():
+        try:
+            k = parse_key(key.split(":"))
+            cfg = parse_cfg(blocks)
+        # IndexError: a key with too few ':' parts (the parse_key
+        # lambdas index into the split) — malformed like the rest, and
+        # this load runs lazily inside kernel dispatch, so one bad
+        # entry must never crash a run
+        except (ValueError, TypeError, IndexError):
+            continue  # skip malformed entries, keep the rest
+        table[k] = cfg
+        n += 1
+    return n
+
+
+def save_json_table(path: str, table: Dict[Tuple, object]) -> str:
+    """Write `table` (key tuple -> config with .as_tuple()) to `path`
+    atomically; returns the path."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    raw = {":".join(str(p) for p in key): list(cfg.as_tuple())
+           for key, cfg in sorted(table.items())}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(raw, f, indent=1)
+    os.replace(tmp, path)  # atomic publish, like training/checkpoint.py
+    return path

@@ -39,9 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import json
 import math
-import os
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -540,10 +538,8 @@ _cache_loaded = False
 
 
 def block_cache_path() -> str:
-    return os.environ.get(
-        "FLASH_BLOCKS_CACHE",
-        os.path.join(os.path.expanduser("~"), ".cache", "dpfs_tpu",
-                     "flash_blocks.json"))
+    from .block_cache import default_cache_path
+    return default_cache_path("FLASH_BLOCKS_CACHE", "flash_blocks.json")
 
 
 def _table_key(t: int, head_dim: int, dtype) -> Tuple[int, int, str, str]:
@@ -555,34 +551,16 @@ def _table_key(t: int, head_dim: int, dtype) -> Tuple[int, int, str, str]:
 def load_block_cache(path: Optional[str] = None) -> int:
     """Merge the JSON cache into the in-memory table; returns entries read.
     Unreadable/garbled files are ignored (the table still has defaults)."""
-    path = path or block_cache_path()
-    try:
-        with open(path) as f:
-            raw = json.load(f)
-    except (OSError, ValueError):
-        return 0
-    n = 0
-    for key, blocks in raw.items():
-        try:
-            t_bucket, hd, dtype_name, backend = key.split(":")
-            cfg = BlockConfig(*(int(b) for b in blocks))
-        except (ValueError, TypeError):
-            continue  # skip malformed entries, keep the rest
-        _BLOCK_TABLE[(int(t_bucket), int(hd), dtype_name, backend)] = cfg
-        n += 1
-    return n
+    from .block_cache import load_json_table
+    return load_json_table(
+        path or block_cache_path(), _BLOCK_TABLE,
+        lambda parts: (int(parts[0]), int(parts[1]), parts[2], parts[3]),
+        lambda blocks: BlockConfig(*(int(b) for b in blocks)))
 
 
 def save_block_cache(path: Optional[str] = None) -> str:
-    path = path or block_cache_path()
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    raw = {":".join(str(p) for p in key): list(cfg.as_tuple())
-           for key, cfg in sorted(_BLOCK_TABLE.items())}
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(raw, f, indent=1)
-    os.replace(tmp, path)  # atomic publish, like training/checkpoint.py
-    return path
+    from .block_cache import save_json_table
+    return save_json_table(path or block_cache_path(), _BLOCK_TABLE)
 
 
 def set_block_config(t: int, head_dim: int, dtype,

@@ -572,6 +572,8 @@ class PagedEngine:
                  slo_classes=None, default_class: str = "standard",
                  max_queue: int = 0, debug_host_sampler: bool = False,
                  kv_dtype=None, decode_weight_dtype=None,
+                 paged_attn_impl: str = "gather",
+                 paged_attn_interpret: bool = False,
                  tracer=None, writer=None, request_tracer=None,
                  flight=None, telemetry=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
@@ -628,6 +630,15 @@ class PagedEngine:
         self._sample = make_token_sampler(model, temperature=temperature,
                                           top_k=top_k, top_p=top_p)
         _setup_decode_weights(self, model, mesh, params, decode_weight_dtype)
+        # paged-attention impl (ISSUE 14): 'gather' materializes the dense
+        # page view (the oracle); 'pallas' walks the page table in place.
+        # Resolved ONCE here — a non-TPU backend without the interpreter
+        # opt-in falls back to gather with a one-time warning, so every
+        # compiled program below agrees on one impl.
+        from ..ops.pallas.paged_attention import resolve_paged_attn_impl
+        self.paged_attn_impl = resolve_paged_attn_impl(
+            paged_attn_impl, interpret=paged_attn_interpret)
+        self._paged_attn_interpret = bool(paged_attn_interpret)
         # int8 pages: codes + per-head-vector scales through the SAME
         # lease/COW/free accounting (kv_manager.PagedKVPool docstring)
         self.kv_dtype = kv_dtype
@@ -673,6 +684,7 @@ class PagedEngine:
     def _build_step(self):
         model, ps, dtype = self.model, self.page_size, self._dtype
         debug = self._debug_host_sampler
+        impl, interp = self.paged_attn_impl, self._paged_attn_interpret
         pspec = self.pool.pspec   # plain POOL_SPEC, or (codes, scales)
 
         def shard_fn(params, pool_k, pool_v, tokens, pos, seeds, tbl):
@@ -680,7 +692,8 @@ class PagedEngine:
             cos_t, sin_t = self._tables()
             pool_k, pool_v, logits = _paged_decode_one(
                 model, params, pool_k, pool_v, tokens, pos, tbl, ps,
-                cos_t, sin_t, dtype)
+                cos_t, sin_t, dtype, attn_impl=impl,
+                attn_interpret=interp)
             if debug:
                 return pool_k, pool_v, logits.astype(jnp.float32)
             tok = self._sample(logits, seeds, pos + 1)
@@ -696,6 +709,7 @@ class PagedEngine:
 
     def _build_chunk(self, cw: int):
         model, ps, dtype = self.model, self.page_size, self._dtype
+        impl, interp = self.paged_attn_impl, self._paged_attn_interpret
         pspec = self.pool.pspec
 
         def shard_fn(params, pool_k, pool_v, chunk, start, qlen, tbl,
@@ -704,7 +718,8 @@ class PagedEngine:
             cos_t, sin_t = self._tables()
             pool_k, pool_v, logits = _paged_prefill_chunk(
                 model, params, pool_k, pool_v, chunk, start, qlen, tbl,
-                dstp, dsto, ps, cos_t, sin_t, dtype)
+                dstp, dsto, ps, cos_t, sin_t, dtype, attn_impl=impl,
+                attn_interpret=interp)
             tok = self._sample(logits, seeds, start + qlen)
             return pool_k, pool_v, tok
 
@@ -1213,6 +1228,7 @@ class PagedEngine:
             # -- token-granular occupancy (the paged win, measured) ------
             "page_size": self.page_size,
             "kv_dtype": self.kv_dtype or "native",
+            "paged_attn": self.paged_attn_impl,
             "num_pages": self.pool.num_pages,
             "pages_in_use": self.pool.pages_in_use,
             "pages_in_use_mean": round(self._pages_used_sum / steps

@@ -214,7 +214,7 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
     }
     if "kv_util_mean" in stats:        # the paged engine's extra telemetry
         summary.update({k: stats[k] for k in (
-            "kv_dtype",
+            "kv_dtype", "paged_attn",
             "kv_util_mean", "kv_fragmentation_mean", "pages_in_use_mean",
             "prefix_hit_rate", "cow_copies", "preemptions", "max_live",
             "max_interleaved_prefill_positions")})

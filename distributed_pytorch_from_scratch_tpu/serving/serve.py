@@ -104,6 +104,17 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "(~2x the tokens per HBM byte at hd 64; greedy "
                         "quality pinned in tests/test_quant.py); the "
                         "speculative drafter pool inherits it")
+    g.add_argument("--paged_attn", choices=["gather", "pallas"],
+                   default="gather",
+                   help="--paged: the attend over the page table. "
+                        "'gather' materializes the dense page view per "
+                        "step (the oracle); 'pallas' walks the "
+                        "(slots, max_pages) table in place on TPU "
+                        "(ops/pallas/paged_attention.py — no per-step "
+                        "HBM copy of the context, int8 dequant fused "
+                        "into the block loop). Token-identical greedy "
+                        "output by contract; non-TPU backends fall back "
+                        "to gather with a one-time warning")
     g.add_argument("--num_pages", type=int, default=0,
                    help="--paged: page-pool HBM budget in pages (0 = "
                         "slots x ceil(buf_len/page_size), i.e. no "
@@ -232,6 +243,9 @@ def get_serve_args(argv=None) -> argparse.Namespace:
         if args.kv_dtype != "native":
             p.error("--kv_dtype is a --paged knob (the slot pool stores "
                     "the compute dtype; only PagedKVPool quantizes)")
+        if args.paged_attn != "gather":
+            p.error("--paged_attn is a --paged knob (the slot engine has "
+                    "no page table to walk)")
         if args.class_mix:
             p.error("--class_mix needs --paged (the FIFO engine has no "
                     "SLO classes)")
@@ -456,6 +470,7 @@ def serve(args: argparse.Namespace) -> dict:
                 temperature=args.temperature, top_k=args.decode_top_k,
                 top_p=args.decode_top_p, kv_dtype=kv_dtype,
                 decode_weight_dtype=wdtype,
+                paged_attn_impl=args.paged_attn,
                 slo_classes=parse_slo_classes(args.slo_classes),
                 default_class=args.default_class,
                 max_queue=args.queue_limit, tracer=tracer, writer=writer,
@@ -538,7 +553,7 @@ def serve(args: argparse.Namespace) -> dict:
             "tpot_ms_p50", "tpot_ms_p95", "queue_wait_ms_p50",
             "queue_wait_ms_p95", "prefill_pad_waste_eliminated")},
     }
-    for k in ("kv_dtype",
+    for k in ("kv_dtype", "paged_attn",
               "kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
               "cow_copies", "preemptions", "max_live",
               "max_interleaved_prefill_positions", "slo_attainment",

@@ -175,6 +175,7 @@ class SpeculativeEngine(PagedEngine):
     def _build_draft(self):
         model, ps, k = self.drafter_model, self.page_size, self.k
         dtype = self._ddtype
+        impl, interp = self.paged_attn_impl, self._paged_attn_interpret
         temperature, top_k, top_p = (self._temperature, self._top_k,
                                      self._top_p)
 
@@ -186,7 +187,8 @@ class SpeculativeEngine(PagedEngine):
                 pk, pv, tok = carry
                 pk, pv, logits = _paged_decode_one(
                     model, params, pk, pv, tok, pos + j, tbl, ps,
-                    cos_t, sin_t, dtype)
+                    cos_t, sin_t, dtype, attn_impl=impl,
+                    attn_interpret=interp)
                 full = _full_vocab_logits(model, logits)     # (b, V) f32
                 if temperature == 0.0:
                     nxt = jnp.argmax(full, axis=-1).astype(jnp.int32)
@@ -230,6 +232,7 @@ class SpeculativeEngine(PagedEngine):
     def _build_verify(self):
         model, ps, k = self.model, self.page_size, self.k
         dtype = self._dtype
+        impl, interp = self.paged_attn_impl, self._paged_attn_interpret
         temperature, top_k, top_p = (self._temperature, self._top_k,
                                      self._top_p)
         cw = k + 1
@@ -253,7 +256,8 @@ class SpeculativeEngine(PagedEngine):
                  jnp.asarray(draft, jnp.int32)], axis=1)      # (b, cw)
             pool_k, pool_v, logits = _paged_prefill_chunk(
                 model, params, pool_k, pool_v, block, pos, qlen, tbl,
-                dstp, dsto, ps, cos_t, sin_t, dtype, all_logits=True)
+                dstp, dsto, ps, cos_t, sin_t, dtype, all_logits=True,
+                attn_impl=impl, attn_interpret=interp)
             full = _full_vocab_logits(model, logits)          # (b, cw, V)
             b = block.shape[0]
             if temperature == 0.0:
@@ -335,13 +339,15 @@ class SpeculativeEngine(PagedEngine):
 
     def _build_drafter_chunk(self, cw: int):
         model, ps, dtype = self.drafter_model, self.page_size, self._ddtype
+        impl, interp = self.paged_attn_impl, self._paged_attn_interpret
 
         def shard_fn(params, pool_k, pool_v, chunk, start, qlen, tbl,
                      dstp, dsto):
             cos_t, sin_t = self._dtables()
             pool_k, pool_v, _ = _paged_prefill_chunk(
                 model, params, pool_k, pool_v, chunk, start, qlen, tbl,
-                dstp, dsto, ps, cos_t, sin_t, dtype)
+                dstp, dsto, ps, cos_t, sin_t, dtype, attn_impl=impl,
+                attn_interpret=interp)
             # only the K/V writes matter: the draft loop re-reads the cache
             # next round (the dead logits head DCEs out of the program)
             return pool_k, pool_v

@@ -1,0 +1,64 @@
+#!/bin/bash
+# Round-15 paged-attention kernel session (ISSUE 14): measure the
+# gather-vs-pallas win on real chips.
+#   0. static preflight — graftcheck layer 1 (the layer-2 pallas
+#      contracts run in CPU CI; chip windows don't pay for compiles).
+#   1. autotune — scripts/tune_flash_blocks.py --paged sweeps
+#      pages_per_block per (page_size, kv_dtype) serving shape and
+#      persists the winners, so every later pallas dispatch this round
+#      (and every later round on this backend) runs the tuned blocks.
+#   2. kernel A/B sweep — bench --serving --paged_attn pallas at page
+#      sizes 16 and 64: the record carries pallas_vs_gather, both arms'
+#      TTFT/TPOT p95, and the analytic decode HBM bytes/step for both
+#      impls (the gather-copy elimination as numbers).
+#   3. int8 arm — the same A/B over int8 KV pages + int8 decode weights:
+#      the kernel's fused dequant vs the gather path's dequantized view,
+#      at the bandwidth floor PR 8 set.
+#   4. speculative arm — --speculate 4 over the pallas impl (draft,
+#      verify, and chunk prefill all walk the table in place).
+#   5. telemetry-exported serve.py loadgen on the pallas impl (the obs
+#      plane rides along; scrape probe mid-run).
+#   6. gate — check_bench_regression vs the committed trajectory; the
+#      new decode_hbm_bytes_per_step metric is directional (up = fail).
+# Weights are random inits (byte traffic depends on shapes, not values);
+# token identity is pinned by CPU tests (tests/test_paged_kernel.py).
+# Idempotent; reuses the round-5 session helpers.
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r15
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r15 paged-kernel pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 0. static preflight: layer-1 sweep, report landed for summarize
+step graftcheck 240 python scripts/graftcheck.py --no-trace --json runs/r15/graftcheck.json
+
+# 1. autotune the paged kernel's pages_per_block on this chip and persist
+step tunepaged 900 python scripts/tune_flash_blocks.py --paged --write_cache
+
+# 2. the kernel A/B at two page sizes (record carries pallas_vs_gather +
+# decode HBM bytes/step for both impls)
+bench_line pagedps16 1200 --serving --paged_attn pallas --page_size 16 --serve_requests 24 --slots 8 --prompt_len 64 --gen_tokens 128
+bench_line pagedps64 1200 --serving --paged_attn pallas --page_size 64 --serve_requests 24 --slots 8 --prompt_len 64 --gen_tokens 128
+
+# 3. int8 arm: fused in-kernel dequant vs the gather path's dequantized
+# HBM view, int8 weights holding the PR 8 weight-read floor
+bench_line pagedint8 1200 --serving --paged_attn pallas --page_size 16 --kv_dtype int8 --decode_weight_dtype int8 --serve_requests 24 --slots 8 --prompt_len 64 --gen_tokens 128
+
+# 4. speculative arm: draft + K+1 verify + chunk prefill all on the kernel
+bench_line pagedspec 1500 --serving --paged_attn pallas --speculate 4 --page_size 16 --serve_requests 24 --slots 8 --prompt_len 64 --gen_tokens 128
+
+# 5. telemetry-exported loadgen on the pallas impl; mid-run scrape probe
+(sleep 45 && curl -s http://127.0.0.1:9316/metrics.json > runs/r15/scrape_mid_run.json) &
+step servepallas 900 python -m distributed_pytorch_from_scratch_tpu.serving.serve --random_init --paged --paged_attn pallas --trace_requests --metrics_port 9316 --rollup_interval 1 --num_requests 64 --rate 16 --slots 12 --num_pages 48 --page_size 16 --max_new_tokens 48 --prompt_len_min 8 --prompt_len_max 96 --log_dir runs/r15/serve_logs
+
+# 6. regression gate: the flagship A/B line vs the committed trajectory
+# (tokens/s within tolerance AND decode bytes/step not up)
+step gate 120 python scripts/check_bench_regression.py --fresh runs/r15/bench_pagedps16.json
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r15 paged-kernel done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -44,6 +44,11 @@ LOWER_BETTER_UNITS = ("ms/step", "ms/step (analytic)")
 THROUGHPUT_FIELDS = ("value", "vs_baseline", "paged_vs_slot",
                      "accepted_tokens_per_dispatch")
 LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95")
+# analytic decode-dispatch HBM traffic (ISSUE 14): strictly directional —
+# a serving record whose per-step bytes GREW vs the trajectory regressed
+# the decode roofline (e.g. the pallas arm silently fell back to gather,
+# or the gather view grew), whatever tokens/s happened to measure
+BYTES_FIELDS = ("decode_hbm_bytes_per_step",)
 
 
 def load_record(path):
@@ -128,6 +133,8 @@ def metric_checks(fresh, base, tol_pct, tol_latency_pct):
         for f in THROUGHPUT_FIELDS:
             fields.append((f, "up", tol_pct))
         for f in LATENCY_FIELDS:
+            fields.append((f, "down", tol_latency_pct))
+        for f in BYTES_FIELDS:
             fields.append((f, "down", tol_latency_pct))
     checks, skipped = [], []
     for field, direction, tol in fields:

@@ -14,6 +14,12 @@ block_k) x (bwd_block_q, bwd_block_k) grid, plus the XLA dense attention as
 the floor. Prints a table and the best combo per shape. Run on hardware:
 
     python scripts/tune_flash_blocks.py [--quick]
+
+`--paged` sweeps the PAGED-attention kernel instead (ISSUE 14):
+pages_per_block per (page_size, kv_dtype) serving decode shape
+(ops/pallas/paged_attention.py's autotuner table; --write_cache persists
+to the paged JSON cache so every later `--paged_attn pallas` dispatch on
+this backend runs the tuned blocks).
 """
 
 import argparse
@@ -121,7 +127,41 @@ def parse_args(argv=None):
                          "~/.cache/dpfs_tpu/flash_blocks.json) so every "
                          "later flash_attention call on this backend uses "
                          "it automatically (get_block_config)")
+    ap.add_argument("--paged", action="store_true",
+                    help="sweep the PAGED-attention kernel instead "
+                         "(ops/pallas/paged_attention.py): pages_per_block "
+                         "per (page_size, head_dim, kv_dtype) decode "
+                         "shape; --write_cache persists to "
+                         "PAGED_BLOCKS_CACHE or "
+                         "~/.cache/dpfs_tpu/paged_blocks.json")
     return ap.parse_args(argv)
+
+
+def sweep_paged(args):
+    """Time the paged decode dispatch per pages_per_block candidate at the
+    serving shapes that matter: page sizes {8, 16, 32, 64} x kv_dtype
+    {native, int8} at the 45m head shape (kvh8 hd64), GQA (kvh2 group4)
+    at the flagship page size. One table row per shape; the winner lands
+    in the autotuner table (and the JSON cache with --write_cache)."""
+    from distributed_pytorch_from_scratch_tpu.ops.pallas.paged_attention import (  # noqa: E501
+        autotune_paged_block_config)
+
+    sweep = (1, 2, 4) if args.quick else (1, 2, 4, 8)
+    # NOTE the table key is (page_size, head_dim, kv_dtype, backend) —
+    # kv_heads/group are timing context, not key parts — so the GQA
+    # shape shares (16, 64, native)'s entry and must sweep FIRST: the
+    # flagship kvh8 shape sweeps last so ITS winner is the one that
+    # persists (the flash sweep's convention, see main()'s shape list)
+    shapes = [(16, 64, None, 2, 4)]               # GQA: kvh2, group 4
+    shapes += [(ps, 64, kv, 8, 1) for ps in (8, 16, 32, 64)
+               for kv in (None, "int8")]
+    for ps, hd, kv, kvh, grp in shapes:
+        best = autotune_paged_block_config(
+            ps, hd, kv_dtype=kv, kv_heads=kvh, group=grp, sweep=sweep,
+            iters=args.iters, write_cache=args.write_cache)
+        print(f"  paged ps{ps:3d} hd{hd} kv={kv or 'native'} kvh{kvh} "
+              f"g{grp}: best pages_per_block={best.pages_per_block}",
+              flush=True)
 
 
 def main():
@@ -134,6 +174,9 @@ def main():
     assert jax.devices()[0].platform != "cpu", (
         "run on TPU hardware; devices: %s" % jax.devices())
     print("device:", jax.devices()[0].device_kind)
+
+    if args.paged:
+        return sweep_paged(args)
 
     sizes = [256, 512, 1024] if args.quick else [128, 256, 512, 1024, 2048]
     blocks = list(itertools.product(sizes, sizes))

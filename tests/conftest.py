@@ -100,6 +100,22 @@ CORE_LANE = {
         "test_spec_refuses_invalid_configs",
         "test_spec_serve_dry_run_smoke",
     ],
+    # paged-attention kernel (ISSUE 14): the block-level oracle (decode +
+    # int8 chunk), the engine token-identity anchor at tp=2 (native +
+    # int8 fused dequant), the CPU fallback warning + the CLI scope
+    # refusal, the gather-copy pricing pin, and the pallas dry-run rot
+    # guard; the full family/GQA/speculative/preempt matrix runs in the
+    # default lane
+    "test_paged_kernel.py": [
+        "test_kernel_decode_matches_dense_oracle[8-2-4]",
+        "test_kernel_chunk_matches_dense_oracle[True]",
+        "test_pallas_matches_gather_greedy[2-8]",
+        "test_pallas_matches_gather_int8_kv[2]",
+        "test_pallas_falls_back_to_gather_on_cpu_with_warning",
+        "test_serve_cli_refuses_paged_attn_without_paged",
+        "test_paged_decode_hbm_bytes_drops_gather_copy",
+        "test_paged_serve_dry_run_pallas_smoke",
+    ],
     # quantized wires + caches (ISSUE 8): the shared-rule round-trip
     # oracles, the int8 DP-wire error pin (the bf16 canary's sibling),
     # one ring_q kernel bound, the int8-KV greedy-quality pin + the

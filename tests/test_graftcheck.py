@@ -323,6 +323,36 @@ def test_paged_decode_donation_aliased_and_lowering_stable(
     assert res["ok"], res["detail"]
 
 
+def test_pallas_decode_same_schedule_and_stable_lowering(
+        contracts_mod, programs_mod):
+    """ISSUE 14 layer-2 satellite: the PALLAS decode dispatch (the
+    kernel lowered through the interpreter on the contract mesh) must
+    (a) satisfy the SAME expected_collectives schedule as the gather
+    impl — the kernel changes HBM traffic, never the wire, so any new
+    collective is a contract failure, (b) keep the donated pool halves
+    aliased, and (c) lower byte-identically from 3 host states — the
+    scalar-prefetched page table must never bake values into the
+    program."""
+    from distributed_pytorch_from_scratch_tpu.obs.attribution import (
+        expected_collectives)
+    prog = programs_mod.paged_decode_program(paged_attn="pallas")
+    res = contracts_mod.check_collective_inventory(
+        prog, expected_collectives(**prog.config))
+    assert res["ok"], res["detail"]
+    res = contracts_mod.check_donation_aliased(prog)
+    assert res["ok"], res["detail"]
+    res = contracts_mod.check_stable_lowering(
+        "paged_decode_pallas",
+        contracts_mod._decode_lowerings(paged_attn="pallas"))
+    assert res["ok"], res["detail"]
+    # the gather and pallas programs carry the same (axis, op) inventory
+    gather = programs_mod.paged_decode_program()
+    inv = lambda p: {k: v["count"] for k, v in contracts_mod.inventory(
+        contracts_mod.parse_collectives_by_axis(p.compiled_text,
+                                                p.mesh)).items()}
+    assert inv(prog) == inv(gather), (inv(prog), inv(gather))
+
+
 def test_axis_classification_on_the_test_mesh(contracts_mod):
     """The HLO group classifier must map both replica_groups formats and
     permute pairs onto the right mesh axes (everything else rests on

@@ -29,7 +29,9 @@ R5 = os.path.join(REPO, "runs", "r5")
 # r12 the ZeRO stage x wire ladder + RS/AG breakdown arm, r13 the
 # regression-gated trajectory point + traced/flight-recorded serving,
 # r14 the live telemetry plane: exported serving + collector rollup +
-# the SLO-collapse anomaly arm with cross-linked device profiling)
+# the SLO-collapse anomaly arm with cross-linked device profiling,
+# r15 the paged-attention kernel: pages_per_block autotune + the
+# gather-vs-pallas A/B sweep with int8 and speculative arms)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -38,7 +40,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r11"),
                             os.path.join(REPO, "runs", "r12"),
                             os.path.join(REPO, "runs", "r13"),
-                            os.path.join(REPO, "runs", "r14"))
+                            os.path.join(REPO, "runs", "r14"),
+                            os.path.join(REPO, "runs", "r15"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
