@@ -48,7 +48,8 @@ from distributed_pytorch_from_scratch_tpu.config import (IGNORE_INDEX,
                                                          model_preset)
 from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
 from distributed_pytorch_from_scratch_tpu.training.metrics import (
-    allreduce_p50_us, chip_peak_flops, device_memory_gib, model_flops_per_step)
+    ProfilerTrace, allreduce_p50_us, chip_peak_flops, device_memory_gib,
+    model_flops_per_step)
 from distributed_pytorch_from_scratch_tpu.training.train_step import (
     build_train_step, build_train_step_multi)
 
@@ -233,6 +234,31 @@ def parse_args(argv=None):
                    help="--serving: arm a bounded jax.profiler window of "
                         "N decode steps when a flight dump fires, cross-"
                         "linked from the dump; needs --flight_records")
+    p.add_argument("--profile_every", type=int, default=0, metavar="N",
+                   help="--serving: duty-cycled MEASURED attribution on "
+                        "the paged arm (training/metrics."
+                        "DutyCycleProfiler): every N decode steps capture "
+                        "a --profile_window-step jax.profiler window, "
+                        "parse it (obs/profparse), land "
+                        "profile_attribution events in --obs_dir and "
+                        "carry measured_vs_analytic in the record; 0 = "
+                        "off")
+    p.add_argument("--profile_window", type=int, default=4, metavar="W",
+                   help="--profile_every: decode steps per capture "
+                        "window (must be <= N)")
+    p.add_argument("--profile_budget_mb", type=float, default=64.0,
+                   help="--profile_every: total on-disk capture budget; "
+                        "exhaustion stops sampling between windows, "
+                        "never mid-window")
+    p.add_argument("--capture_profile", action="store_true",
+                   help="--breakdown: capture the scanned multi-step "
+                        "program under a jax.profiler window "
+                        "(training/metrics.ProfilerTrace into --obs_dir), "
+                        "parse it (obs/profparse) and attach the "
+                        "measured-vs-analytic reconcile to the record "
+                        "(measured_vs_analytic) — the analytic roofline "
+                        "checked against the device timeline, not just "
+                        "asserted")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="--serving: add a SPECULATIVE arm to the A/B — a "
                         "'tiny'-preset drafter proposes K tokens per round, "
@@ -268,6 +294,35 @@ def parse_args(argv=None):
     if args.profile_on_anomaly and not args.flight_records:
         p.error("--profile_on_anomaly arms on flight-dump triggers; add "
                 "--flight_records (and --serving)")
+    if args.profile_every:
+        if not args.serving:
+            p.error("--profile_every is a --serving knob here (training "
+                    "runs get the duty profiler from train.py)")
+        if args.profile_on_anomaly:
+            p.error("--profile_every excludes --profile_on_anomaly (both "
+                    "drive the one-capture-at-a-time device profiler)")
+        if not args.obs_dir:
+            p.error("--profile_every needs a metrics dir: captures and "
+                    "the parsed profile_attribution events land in "
+                    "--obs_dir (point it somewhere writable)")
+        if not 1 <= args.profile_window <= args.profile_every:
+            p.error(f"--profile_window must be in [1, --profile_every], "
+                    f"got window {args.profile_window} with every "
+                    f"{args.profile_every}")
+        if args.profile_budget_mb <= 0:
+            p.error(f"--profile_budget_mb must be > 0, got "
+                    f"{args.profile_budget_mb}")
+    if args.capture_profile:
+        if not args.breakdown:
+            p.error("--capture_profile is a --breakdown knob (the "
+                    "serving arms use --profile_every)")
+        if args.analytic:
+            p.error("--capture_profile needs device timing; drop "
+                    "--analytic (the analytic report is what the capture "
+                    "is reconciled AGAINST)")
+        if not args.obs_dir:
+            p.error("--capture_profile needs --obs_dir (the capture "
+                    "lands there)")
     if args.decode_weight_dtype != "native" and not args.serving:
         p.error("--decode_weight_dtype is a --serving knob")
     if args.remat is None:
@@ -408,13 +463,9 @@ def make_batch(cfg, B, t_real, t_pad, seed=1):
 def chip_key() -> str:
     """attribution's roofline key for the attached chip (v5e assumed when
     unknown — the report labels the assumption)."""
-    from distributed_pytorch_from_scratch_tpu.obs.attribution import CHIP_SPECS
-    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
-    kind = kind.replace("lite", "e")
-    for key in sorted(CHIP_SPECS, key=len, reverse=True):
-        if key in kind:
-            return key
-    return "v5e"
+    from distributed_pytorch_from_scratch_tpu.obs.attribution import (
+        chip_key_for)
+    return chip_key_for(jax.devices()[0].device_kind)
 
 
 def default_batch(args) -> int:
@@ -586,18 +637,19 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     # dir cannot take writes (a silently traceless traced bench is worse
     # than none)
     obs_tracer = obs_writer = obs_rt = obs_flight = None
-    obs_telemetry = obs_profiler = None
+    obs_telemetry = obs_profiler = obs_duty = None
     if args.trace_requests or args.flight_records \
-            or args.metrics_port is not None:
+            or args.metrics_port is not None or args.profile_every:
         from distributed_pytorch_from_scratch_tpu.obs import (
             FlightRecorder, RequestTracer, SpanTracer, TelemetryExporter)
         from distributed_pytorch_from_scratch_tpu.serving.serve import (
             require_writable_dir)
         from distributed_pytorch_from_scratch_tpu.training.metrics import (
-            AnomalyProfiler, MetricsWriter)
+            AnomalyProfiler, DutyCycleProfiler, MetricsWriter)
         require_writable_dir(
             args.obs_dir,
-            "--trace_requests/--flight_records/--metrics_port")
+            "--trace_requests/--flight_records/--metrics_port/"
+            "--profile_every")
         obs_tracer = SpanTracer(args.obs_dir, process_name="bench-serving")
         obs_writer = MetricsWriter(args.obs_dir, process_index=0)
         if args.metrics_port is not None:
@@ -609,12 +661,17 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         if args.flight_records:
             if args.profile_on_anomaly:
                 obs_profiler = AnomalyProfiler(
-                    args.obs_dir, window_steps=args.profile_on_anomaly)
+                    args.obs_dir, window_steps=args.profile_on_anomaly,
+                    writer=obs_writer)
             obs_flight = FlightRecorder(args.obs_dir,
                                         profiler=obs_profiler)
         if args.trace_requests:
             obs_rt = RequestTracer(writer=obs_writer, tracer=obs_tracer,
                                    flight=obs_flight)
+        if args.profile_every:
+            obs_duty = DutyCycleProfiler(
+                args.obs_dir, args.profile_every, args.profile_window,
+                args.profile_budget_mb, writer=obs_writer)
     try:
         paged = PagedEngine(
             model, mesh, params, num_slots=args.serve_requests,
@@ -624,7 +681,7 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             paged_attn_impl=args.paged_attn,
             tracer=obs_tracer, writer=obs_writer,
             request_tracer=obs_rt, flight=obs_flight,
-            telemetry=obs_telemetry)
+            telemetry=obs_telemetry, duty_profiler=obs_duty)
         # the impl the engine actually built (a non-TPU backend downgrades
         # 'pallas' to 'gather' with a warning — the record must not lie)
         paged_attn = paged.paged_attn_impl
@@ -633,9 +690,11 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     finally:
         # a mid-run failure is exactly when the trace matters: finalise
         # trace.json + flush the events before the exception propagates
-        # (profiler -> exporter -> tracer -> writer, the serve.py order)
+        # (profilers -> exporter -> tracer -> writer, the serve.py order)
         if obs_profiler is not None:
             obs_profiler.close()
+        if obs_duty is not None:
+            obs_duty.close()
         if obs_telemetry is not None:
             obs_telemetry.close()
         if obs_tracer is not None:
@@ -661,6 +720,48 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     decode_hbm = {impl: paged_decode_hbm_bytes(cfg, paged_attn=impl,
                                                **hbm_kw)
                   for impl in ("gather", "pallas")}
+
+    # ISSUE 15: measured attribution on the paged arm — the duty
+    # profiler's last finished capture parsed and reconciled against the
+    # decode roofline the record already prices analytically (the byte
+    # model above over the chip's HBM bandwidth). The regression gate
+    # treats the measured per-phase / comm ms directionally (up = fail).
+    measured_vs_analytic = None
+    if obs_duty is not None and obs_duty.captures:
+        from distributed_pytorch_from_scratch_tpu.obs import profparse
+        from distributed_pytorch_from_scratch_tpu.obs.attribution import (
+            CHIP_SPECS)
+        try:
+            measured = profparse.parse_capture(obs_duty.captures[-1])
+        except (ValueError, OSError) as e:
+            measured = None
+            print(f"bench[serving]: duty capture unparseable "
+                  f"({type(e).__name__}: {e}) — record carries no "
+                  f"measured_vs_analytic", file=sys.stderr)
+        if measured is not None:
+            _, hbm_bw = CHIP_SPECS.get(chip_key(), CHIP_SPECS["v5e"])
+            roofline_ms = (decode_hbm[paged_attn]["total_bytes"]
+                           / hbm_bw * 1e3)
+            analytic_rep = {
+                "phases": [{"name": "compute",
+                            "ms": round(roofline_ms, 4)}],
+                "total_ms": round(roofline_ms, 4)}
+            # the dispatches the LAST capture actually covered (a
+            # close()-truncated window is shorter than the configured W)
+            steps = (obs_duty.capture_steps[-1]
+                     if obs_duty.capture_steps else obs_duty.window)
+            measured_vs_analytic = {
+                "capture": obs_duty.captures[-1],
+                "analytic_decode_roofline_ms": round(roofline_ms, 4),
+                **profparse.reconcile(measured, analytic_rep,
+                                      steps=steps)}
+            print(f"bench[serving]: measured decode "
+                  f"{measured_vs_analytic['measured_step_ms']:.2f} ms/step"
+                  f" vs analytic roofline {roofline_ms:.2f} ms "
+                  f"(measured comm "
+                  f"{measured_vs_analytic['comm_ms']:.2f} ms/step)",
+                  file=sys.stderr)
+
     gather_summary = None
     if args.paged_attn == "pallas":
         # the gather arm runs WITHOUT the obs hooks (those closed with
@@ -878,6 +979,17 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
            if obs_telemetry is not None else {}),
         **({"anomaly_profiles": list(obs_profiler.captures)}
            if obs_profiler is not None else {}),
+        # ISSUE 15: the duty-profiled arm's capture accounting rides
+        # UNCONDITIONALLY when the duty profiler ran (an unparseable
+        # capture must not make the record look like --profile_every 0);
+        # the reconcile itself only when the last capture parsed —
+        # gated directionally by check_bench_regression
+        **({"profile_captures": list(obs_duty.captures),
+            "profile_attributions": obs_duty.attributions,
+            "profile_windows_skipped": obs_duty.windows_skipped}
+           if obs_duty is not None else {}),
+        **({"measured_vs_analytic": measured_vs_analytic}
+           if measured_vs_analytic is not None else {}),
         **spec_rec,
         "ttft_ms_p50": paged_summary["ttft_ms_p50"],
         "ttft_ms_p95": paged_summary["ttft_ms_p95"],
@@ -1087,6 +1199,26 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
 
     multi_s = timed(multi_step, lambda x: float(jnp.sum(x))) / spd
 
+    # ISSUE 15: capture the (already warm) scanned program under a real
+    # jax.profiler window so the analytic roofline below is CHECKED
+    # against a device timeline, not just printed next to wall clocks.
+    # Two dispatches = 2 x spd profiled steps; ProfilerTrace owns the
+    # start/stop (the profiler-discipline contract).
+    capture_dir = None
+    capture_steps = 0
+    if args.capture_profile:
+        from distributed_pytorch_from_scratch_tpu.serving.serve import (
+            require_writable_dir)
+        require_writable_dir(args.obs_dir, "--capture_profile")
+        cap_root = os.path.join(args.obs_dir, "profile_breakdown")
+        cap_trace = ProfilerTrace(cap_root, start_step=0, num_steps=2)
+        cap_trace.maybe_start(0)
+        multi_step()
+        loss = multi_step()
+        cap_trace.maybe_stop(2, sync=loss)
+        capture_dir = cap_trace.log_dir
+        capture_steps = 2 * spd
+
     comp = {
         "h2d_ms": round(h2d_s * 1e3, 2),
         "fwd_ms": round(fwd_s * 1e3, 2),
@@ -1114,6 +1246,27 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
     # hardware rather than the datasheet
     p50_us = allreduce_p50_us(mesh, "tp") if tp > 1 else None
     report = emit(measured=comp, allreduce_us=p50_us)
+
+    # parse the capture and reconcile it against the attribution report
+    # just emitted (ISSUE 15): per-phase drift, worst "model is wrong
+    # here" suspects, and the gate-checkable measured ms
+    measured_vs_analytic = None
+    if capture_dir is not None:
+        from distributed_pytorch_from_scratch_tpu.obs import profparse
+        try:
+            measured = profparse.parse_capture(capture_dir)
+        except (ValueError, OSError) as e:
+            print(f"bench[breakdown]: capture unparseable "
+                  f"({type(e).__name__}: {e}) — record carries no "
+                  f"measured_vs_analytic", file=sys.stderr)
+        else:
+            rec = profparse.reconcile(
+                measured, profparse.analytic_phase_report(report),
+                steps=capture_steps)
+            measured_vs_analytic = {"capture": capture_dir, **rec}
+            print("bench[breakdown] measured vs analytic:\n"
+                  + profparse.format_reconcile(rec), file=sys.stderr)
+
     print(json.dumps({
         "metric": (f"step-time breakdown ({args.model}, bf16, {shape_note}, "
                    f"remat={args.remat}; value = single-dispatch step ms, "
@@ -1126,6 +1279,10 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
         "wire_dtype": args.dp_reduce_dtype,
         "zero_stage": args.zero,
         "param_bytes_per_device": pbpd,
+        # ISSUE 15: the profiled-window reconcile (when captured); the
+        # regression gate treats its per-phase / comm ms directionally
+        **({"measured_vs_analytic": measured_vs_analytic}
+           if measured_vs_analytic is not None else {}),
         "attribution": {
             "analytic_step_ms": round(report["analytic_step_ms"], 2),
             "chip": report["chip"],
@@ -1356,11 +1513,15 @@ def main(argv=None):
           f"gather ({B * T * vp * 4 / 2**30:.2f} GiB at this config; "
           f"tested in tests/test_large_vocab.py)", file=sys.stderr)
 
+    # None = no memory_stats on this backend: print 'n/a', never a fake
+    # 0.00 GiB watermark (ISSUE 15 silent-zero fix)
+    mem = device_memory_gib()
+    mem_s = f"{mem:.2f}GiB" if mem is not None else "n/a"
     print(f"bench[{args.model}, remat={remat_used}, attn={attn_used}]: "
           f"{world} device(s) "
           f"[{jax.devices()[0].device_kind}], compile {compile_s:.1f}s, "
           f"step {step_s*1000:.1f}ms, loss {float(loss):.4f}, "
-          f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f}GiB"
+          f"MFU {mfu*100:.1f}%, mem {mem_s}"
           + (f", tp all-reduce p50 {p50:.0f}us (4MiB)" if p50 else ""),
           file=sys.stderr)
 

@@ -218,6 +218,48 @@ def check_unreachable(src: SourceFile) -> List[Violation]:
     return out
 
 
+# ------------------------------------------------------ profiler-discipline --
+
+#: the one module allowed to start/stop jax.profiler traces: it owns the
+#: window mechanics (ProfilerTrace / AnomalyProfiler / DutyCycleProfiler)
+_PROFILER_OWNER = "training/metrics.py"
+_PROFILER_CALLS = {"jax.profiler.start_trace", "jax.profiler.stop_trace"}
+
+
+@rule("profiler-discipline",
+      "jax.profiler.start_trace/stop_trace outside training/metrics.py",
+      "the device profiler is one-capture-at-a-time: a scattered "
+      "start/stop races the ProfilerTrace/AnomalyProfiler/"
+      "DutyCycleProfiler window mechanics (training/metrics.py), so a "
+      "stop fires mid-window and the capture truncates unreadably — the "
+      "exact failure the obs-v4 measured plane cannot tolerate, since "
+      "every capture now parses into a profile_attribution event")
+def check_profiler_discipline(src: SourceFile) -> List[Violation]:
+    if src.path.replace(os.sep, "/").endswith(_PROFILER_OWNER):
+        return []
+    out: List[Violation] = []
+    for node in src.nodes:
+        if isinstance(node, ast.Attribute):
+            name = dotted(node)
+            if name in _PROFILER_CALLS:
+                out.append(Violation(
+                    "profiler-discipline", src.path, node.lineno,
+                    f"{name} outside training/metrics.py breaks the "
+                    f"one-capture-at-a-time window mechanics — drive "
+                    f"captures through ProfilerTrace / AnomalyProfiler / "
+                    f"DutyCycleProfiler instead"))
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "") == "jax.profiler" and any(
+                    a.name in ("start_trace", "stop_trace")
+                    for a in node.names):
+                out.append(Violation(
+                    "profiler-discipline", src.path, node.lineno,
+                    "importing start_trace/stop_trace from jax.profiler "
+                    "outside training/metrics.py — drive captures "
+                    "through the ProfilerTrace owners"))
+    return out
+
+
 # ---------------------------------------------------------- lock-discipline --
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",

@@ -12,6 +12,8 @@ buckets, sentinel thresholds, flight-dump walkthrough, live endpoints).
 from .attribution import (attribution, flash_tile_stats, format_attribution,
                           rank_skew)
 from .collector import FleetCollector, JsonlTailer
+from .profparse import (analytic_phase_report, format_reconcile,
+                        parse_capture, reconcile)
 from .flight import FlightRecorder
 from .goodput import BUCKETS, GoodputMeter
 from .introspect import analyze_compiled, format_analysis, parse_collectives
@@ -29,8 +31,9 @@ __all__ = [
     "FlightRecorder", "GoodputMeter", "HangWatchdog", "HealthSentinel",
     "JsonlTailer", "RequestTracer", "SpanTracer", "TelemetryExporter",
     "TraceContext", "TrainObserver", "TrainingHealthError",
-    "analyze_compiled", "attribution", "flash_tile_stats",
-    "fleet_slo_attainment", "format_analysis", "format_attribution",
-    "merge_traces", "parse_collectives", "rank_skew", "validate_jsonl",
-    "validate_record",
+    "analytic_phase_report", "analyze_compiled", "attribution",
+    "flash_tile_stats", "fleet_slo_attainment", "format_analysis",
+    "format_attribution", "format_reconcile", "merge_traces",
+    "parse_capture", "parse_collectives", "rank_skew", "reconcile",
+    "validate_jsonl", "validate_record",
 ]

@@ -48,6 +48,18 @@ ICI_SPECS = {
 ALLREDUCE_PROBE_BYTES = 4 * 2**20  # metrics.allreduce_p50_us's payload
 
 
+def chip_key_for(device_kind: str) -> str:
+    """CHIP_SPECS key for a jax `device_kind` string ('TPU v6 lite' ->
+    'v6e'; unknown kinds assume v5e — reports label the assumption).
+    The one copy of the lite->e normalization: bench.py's chip_key and
+    train.py's duty-profiler chip detection both route through here."""
+    kind = device_kind.lower().replace(" ", "").replace("lite", "e")
+    for key in sorted(CHIP_SPECS, key=len, reverse=True):
+        if key in kind:
+            return key
+    return "v5e"
+
+
 def calibrate_ici(chip: str, n: int,
                   measured_allreduce_us: Optional[float] = None,
                   probe_bytes: int = ALLREDUCE_PROBE_BYTES):

@@ -162,7 +162,7 @@ class FleetCollector:
 
     # -- folding ----------------------------------------------------------
     _KEEP_TAGS = ("telemetry_snapshot", "serving_summary", "paged_kv_stats",
-                  "rank_phase_stats", "goodput_summary")
+                  "rank_phase_stats", "goodput_summary", "hbm_watermark")
 
     def poll(self) -> int:
         """One collection pass: tail every discovered file and scrape
@@ -226,6 +226,39 @@ class FleetCollector:
                     for cls, d in summary["slo_attainment"].items()}
         return None
 
+    _HBM_DEV_GAUGE = re.compile(r"hbm/d\d+/bytes_in_use")
+
+    @classmethod
+    def _hbm_counts(cls, state: dict):
+        """(available, bytes_in_use, peak_bytes) from a proc's freshest
+        HBM source: live exporter gauges (`hbm/...`, ISSUE 15) win over
+        the last `hbm_watermark` event. None when the proc never
+        published either. bytes_in_use SUMS the per-device gauges when
+        present (the aggregate `hbm/bytes_in_use` gauge is the
+        worst-device watermark — summing semantics must match the event
+        path, or a multi-device proc undercounts in the fleet total)."""
+        snap = state.get("telemetry_snapshot")
+        if snap is not None:
+            g = snap.get("gauges", {})
+            if "hbm/available" in g:
+                if not g["hbm/available"]:
+                    return (False, 0, 0)
+                per_dev = [int(v) for k, v in g.items()
+                           if cls._HBM_DEV_GAUGE.fullmatch(k)]
+                in_use = (sum(per_dev) if per_dev
+                          else int(g.get("hbm/bytes_in_use", 0)))
+                return (True, in_use, int(g.get("hbm/peak_bytes", 0)))
+        ev = state.get("hbm_watermark")
+        if ev is not None:
+            if not ev.get("available"):
+                return (False, 0, 0)
+            devs = ev.get("devices") or []
+            return (True,
+                    sum(int(d.get("bytes_in_use", 0)) for d in devs),
+                    max((int(d.get("peak_bytes", 0)) for d in devs),
+                        default=0))
+        return None
+
     def rollup(self) -> dict:
         """The fleet view from the latest folded state (pure read)."""
         with self._lock:
@@ -235,6 +268,12 @@ class FleetCollector:
         kv_utils = []
         slo_inputs = []
         skew_recs = []
+        # fleet HBM (ISSUE 15): per-proc watermark -> fleet peak gauge.
+        # A proc that REPORTS unavailability still counts (loudly) — the
+        # silent-zero fix must survive aggregation, so 'unavailable' is a
+        # fleet fact, never a 0-byte proc folded into the sum.
+        hbm_in_use = hbm_peak = 0
+        hbm_procs = hbm_unavailable = 0
         for state in procs.values():
             snap = state.get("telemetry_snapshot")
             if snap is not None:
@@ -246,6 +285,15 @@ class FleetCollector:
                     pages_used += int(g.get("serve/pages_in_use", 0))
                 if "serve/kv_util" in g:
                     kv_utils.append(g["serve/kv_util"])
+            hbm = self._hbm_counts(state)
+            if hbm is not None:
+                avail, in_use, peak = hbm
+                if avail:
+                    hbm_procs += 1
+                    hbm_in_use += in_use
+                    hbm_peak = max(hbm_peak, peak)
+                else:
+                    hbm_unavailable += 1
             kv = state.get("paged_kv_stats")
             if kv is not None and snap is None:
                 pages_total += int(kv.get("num_pages", 0))
@@ -270,6 +318,13 @@ class FleetCollector:
                 "util": round(pages_used / pages_total, 4),
                 "kv_util_mean": round(sum(kv_utils) / len(kv_utils), 4)
                 if kv_utils else None,
+            }
+        if hbm_procs or hbm_unavailable:
+            out["hbm"] = {
+                "bytes_in_use_total": hbm_in_use,
+                "peak_bytes_max": hbm_peak,
+                "procs_reporting": hbm_procs,
+                "procs_unavailable": hbm_unavailable,
             }
         if len(skew_recs) >= 2:
             try:

@@ -41,8 +41,12 @@ class TrainObserver:
         profiler = None
         if profile_on_anomaly > 0 and flight_ring > 0:
             from ..training.metrics import AnomalyProfiler
+            # writer: the finished anomaly window parses into a
+            # profile_attribution event (obs v4) — without it the train
+            # path's captures would dodge the measured plane
             profiler = AnomalyProfiler(log_dir,
-                                       window_steps=profile_on_anomaly)
+                                       window_steps=profile_on_anomaly,
+                                       writer=writer)
         self.profiler = profiler
         # the anomaly flight recorder: every span/heartbeat lands in the
         # ring, and the sentinel/watchdog flush it on their halt/stall

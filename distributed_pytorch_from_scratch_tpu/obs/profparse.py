@@ -1,0 +1,307 @@
+"""Measured attribution: parse `jax.profiler` capture dirs and reconcile
+them against the analytic roofline (ISSUE 15).
+
+Everything priced in this repo — the roofline phases, the EQuARX int8
+rings, the ZeRO comm ladder — is ANALYTIC (obs/attribution.py), and until
+now nothing ever checked those prices against a real device timeline:
+`AnomalyProfiler` (PR 12) wrote capture dirs no code read. This module is
+the reader. A capture dir is the `jax.profiler.start_trace` layout:
+
+    <log_dir>/plugins/profile/<timestamp>/<host>.trace.json.gz
+
+where each `*.trace.json.gz` is a Chrome trace-event JSON: metadata
+events name processes ("/device:TPU:0", "/host:CPU") and threads ("XLA
+Ops", "tf_XLATfrtCpuClient/..."), and complete ('X') events carry the
+executed HLO ops — on every backend the op events carry
+`args: {hlo_module, hlo_op}`, which is the discriminator this parser
+keys on (python host-callstack events never do).
+
+The parser classifies device events into a fixed MEASURED taxonomy —
+fusions/dots (compute), each collective kind (the wires the analytic
+model prices), copies/transposes (traffic the model prices at ZERO, so
+any measured ms here is a direct "model is wrong here" signal), and the
+host gap (device idle inside the capture window) — and emits a
+`measured_phases` report in the same phases/total schema the analytic
+side folds into (`analytic_phase_report`), so `reconcile()` can compute
+per-phase drift and name the worst suspects.
+
+Deliberately dependency-free (stdlib only, no jax): importable from
+standalone scripts and from `training/metrics.py` without cycles — the
+schema.py convention.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Dict, List
+
+#: the measured taxonomy, in render order. "compute" is the fold of
+#: fusion+dot+other device work when reconciling (the analytic model
+#: prices compute as one roofline, not per-HLO-op).
+MEASURED_PHASES = (
+    "fusion", "dot", "all-reduce", "all-gather", "reduce-scatter",
+    "collective-permute", "all-to-all", "copy", "transpose", "convert",
+    "transfer", "other", "host_gap",
+)
+
+#: kinds that fold into the single analytic "compute" roofline row
+COMPUTE_KINDS = ("fusion", "dot", "convert", "other")
+#: the wires comm_attribution prices per collective record
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+# op-name prefix -> phase; longest match wins (all-reduce-start must not
+# land in a hypothetical "all" bucket). HLO op names come as
+# "fusion.123" / "all-reduce-start.1" / "%dot.2" — strip the sigil and
+# match the leading identifier.
+_PREFIX_TABLE = [
+    ("all-reduce", "all-reduce"), ("all_reduce", "all-reduce"),
+    ("all-gather", "all-gather"), ("all_gather", "all-gather"),
+    ("reduce-scatter", "reduce-scatter"),
+    ("reduce_scatter", "reduce-scatter"),
+    ("collective-permute", "collective-permute"),
+    ("collective_permute", "collective-permute"),
+    ("all-to-all", "all-to-all"), ("all_to_all", "all-to-all"),
+    ("fusion", "fusion"),
+    ("dot", "dot"), ("gemm", "dot"), ("convolution", "dot"),
+    ("cublas", "dot"), ("matmul", "dot"),
+    ("copy", "copy"), ("dynamic-update-slice", "copy"),
+    ("dynamic_update_slice", "copy"),
+    ("transpose", "transpose"),
+    ("bitcast-convert", "convert"), ("convert", "convert"),
+    ("infeed", "transfer"), ("outfeed", "transfer"),
+    ("send", "transfer"), ("recv", "transfer"),
+]
+
+_TRAILING_ID = re.compile(r"[._]\d+$")
+
+
+def classify_op(name: str) -> str:
+    """HLO op name -> measured phase. 'all-reduce-start.1' -> 'all-reduce',
+    'fusion.2047' -> 'fusion', anything unrecognised -> 'other'."""
+    n = name.strip().lstrip("%").lower()
+    n = _TRAILING_ID.sub("", n)
+    for prefix, phase in _PREFIX_TABLE:
+        if n.startswith(prefix):
+            return phase
+    return "other"
+
+
+def find_trace_files(path: str) -> List[str]:
+    """Every `*.trace.json[.gz]` under a capture dir, whatever level the
+    caller holds: the profiler log dir (contains plugins/profile/...),
+    the plugins/profile dir, one timestamp dir, or a trace file itself."""
+    if os.path.isfile(path):
+        return [path] if path.endswith((".trace.json", ".trace.json.gz")) \
+            else []
+    out = []
+    for pat in ("*.trace.json.gz", "*.trace.json"):
+        out.extend(glob.glob(os.path.join(path, "**", pat), recursive=True))
+    return sorted(out)
+
+
+def _load_trace(path: str) -> dict:
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt", encoding="utf-8", errors="replace") as f:
+            return json.load(f)
+    with open(path, encoding="utf-8", errors="replace") as f:
+        return json.load(f)
+
+
+def parse_capture(path: str) -> dict:
+    """Parse a capture dir (or one trace file) into the measured report.
+
+    Device events are the 'X' events carrying `args.hlo_op`/`hlo_module`
+    (backend-proof: the CPU client thread and the TPU "XLA Ops" lanes
+    both stamp them; python host-callstack events never do). Busy time
+    sums per device lane (pid); `host_gap` is each lane's capture span
+    minus its busy time — device idle the analytic model never prices,
+    i.e. dispatch/input starvation made visible.
+
+    Raises ValueError when the path holds no trace files — a capture
+    that silently parses to zero phases would defeat the whole point.
+    """
+    files = find_trace_files(path)
+    if not files:
+        raise ValueError(f"no *.trace.json[.gz] under {path!r} — not a "
+                         f"jax.profiler capture dir "
+                         f"(expected plugins/profile/<ts>/)")
+    phase_us: Dict[str, float] = {}
+    phase_count: Dict[str, int] = {}
+    lanes: Dict[tuple, dict] = {}   # (file, pid) -> {busy, t0, t1}
+    pnames: Dict[tuple, str] = {}
+    n_device = 0
+    for fp in files:
+        doc = _load_trace(fp)
+        for ev in doc.get("traceEvents", []):
+            ph = ev.get("ph")
+            if ph == "M":
+                if ev.get("name") == "process_name":
+                    pnames[(fp, ev.get("pid"))] = \
+                        ev.get("args", {}).get("name", "")
+                continue
+            if ph != "X":
+                continue
+            args = ev.get("args") or {}
+            if "hlo_op" not in args and "hlo_module" not in args:
+                continue
+            dur = float(ev.get("dur", 0.0))
+            ts = float(ev.get("ts", 0.0))
+            op = args.get("hlo_op") or ev.get("name", "")
+            phase = classify_op(str(op))
+            phase_us[phase] = phase_us.get(phase, 0.0) + dur
+            phase_count[phase] = phase_count.get(phase, 0) + 1
+            n_device += 1
+            lane = lanes.setdefault((fp, ev.get("pid")),
+                                    {"busy": 0.0, "t0": ts, "t1": ts + dur})
+            lane["busy"] += dur
+            lane["t0"] = min(lane["t0"], ts)
+            lane["t1"] = max(lane["t1"], ts + dur)
+    if n_device == 0:
+        raise ValueError(
+            f"{path!r}: {len(files)} trace file(s) but no device op "
+            f"events (hlo_op/hlo_module) — the window closed before any "
+            f"profiled step executed, or the capture is host-only")
+    busy_ms = sum(v for v in phase_us.values()) / 1e3
+    gap_us = sum(max(0.0, ln["t1"] - ln["t0"] - ln["busy"])
+                 for ln in lanes.values())
+    phase_us["host_gap"] = gap_us
+    phase_count["host_gap"] = len(lanes)
+    phases = [{"name": name,
+               "ms": round(phase_us[name] / 1e3, 4),
+               "count": phase_count[name]}
+              for name in MEASURED_PHASES if name in phase_us]
+    total = busy_ms + gap_us / 1e3
+    for p in phases:
+        p["share"] = round(p["ms"] / total, 4) if total else 0.0
+    devices = sorted({pnames.get(k, f"pid{k[1]}") for k in lanes})
+    return {
+        "source": path,
+        "files": len(files),
+        "events": n_device,
+        "devices": devices,
+        "device_busy_ms": round(busy_ms, 4),
+        "host_gap_ms": round(gap_us / 1e3, 4),
+        "phases": phases,
+        "total_ms": round(total, 4),
+    }
+
+
+def phase_ms_map(report: dict) -> Dict[str, float]:
+    """phases list -> {name: ms} (both measured and analytic reports)."""
+    return {p["name"]: float(p["ms"]) for p in report.get("phases", [])}
+
+
+def analytic_phase_report(attr_report: dict) -> dict:
+    """Fold an `obs.attribution.attribution()` report into the measured
+    schema, so the two sides join by phase name:
+
+    * `compute` — the whole roofline step (the analytic model prices
+      compute as max(flops, bytes) per phase, never as HLO-op kinds);
+    * each collective kind — `serialized_ms` summed over the comm
+      records of that kind (count x per-collective ms);
+    * `copy`/`transpose`/`host_gap` — priced at 0 by construction: the
+      model assumes XLA fuses them away and dispatch is amortised, so
+      every measured ms here is drift by definition.
+    """
+    phases = [{"name": "compute",
+               "ms": round(float(attr_report["analytic_step_ms"]), 4)}]
+    comm = attr_report.get("comm") or {}
+    by_kind: Dict[str, float] = {}
+    for r in comm.get("records", []):
+        by_kind[r["kind"]] = by_kind.get(r["kind"], 0.0) \
+            + float(r["serialized_ms"])
+    for kind in COLLECTIVE_KINDS:
+        if kind in by_kind:
+            phases.append({"name": kind, "ms": round(by_kind[kind], 4)})
+    total = sum(p["ms"] for p in phases)
+    return {
+        "source": "analytic",
+        "phases": phases,
+        "comm_exposed_ms": round(float(comm.get("comm_exposed_ms", 0.0)), 4),
+        "total_ms": round(total, 4),
+    }
+
+
+def reconcile(measured: dict, analytic: dict, steps: int = 1,
+              drift_floor_ms: float = 0.05) -> dict:
+    """Join a measured report against an analytic one and compute drift.
+
+    `steps` normalises the measured capture (a W-step window) down to
+    per-step ms before diffing — the analytic side always prices ONE
+    step. Measured compute kinds (fusion/dot/convert/other) fold into
+    the single `compute` row the analytic model prices; collective
+    kinds join one-to-one; copy/transpose/host_gap join against an
+    analytic 0.
+
+    Each row: {phase, measured_ms, analytic_ms, drift_pct} where
+    drift_pct = (measured - analytic) / analytic x 100 (None when the
+    analytic side prices the phase at 0 — an unpriced phase has no
+    denominator, its measured ms IS the finding). `suspects` ranks the
+    "model is wrong here" rows by absolute ms gap, skipping rows under
+    `drift_floor_ms` — sub-floor noise must not outrank real drift.
+    """
+    steps = max(int(steps), 1)
+    m = phase_ms_map(measured)
+    a = phase_ms_map(analytic)
+    rows = []
+    compute_m = sum(m.get(k, 0.0) for k in COMPUTE_KINDS) / steps
+    order = ["compute"] + list(COLLECTIVE_KINDS) + ["copy", "transpose",
+                                                   "transfer", "host_gap"]
+    for name in order:
+        mv = compute_m if name == "compute" else m.get(name, 0.0) / steps
+        av = a.get(name, 0.0)
+        if mv == 0.0 and av == 0.0:
+            continue
+        drift = round((mv - av) / av * 100.0, 1) if av > 0 else None
+        rows.append({"phase": name, "measured_ms": round(mv, 4),
+                     "analytic_ms": round(av, 4), "drift_pct": drift})
+    suspects = []
+    for r in rows:
+        gap = abs(r["measured_ms"] - r["analytic_ms"])
+        if gap < drift_floor_ms:
+            continue
+        note = ("unpriced by the analytic model — every measured ms is "
+                "drift" if r["drift_pct"] is None else
+                f"{r['drift_pct']:+.1f}% vs the analytic price")
+        suspects.append({"phase": r["phase"], "gap_ms": round(gap, 4),
+                         "note": note})
+    suspects.sort(key=lambda s: -s["gap_ms"])
+    measured_step = round(measured["total_ms"] / steps, 4)
+    analytic_step = round(analytic.get("total_ms", 0.0), 4)
+    comm_ms = round(sum(m.get(k, 0.0) for k in COLLECTIVE_KINDS) / steps, 4)
+    return {
+        "steps": steps,
+        "phases": {r["phase"]: r["measured_ms"] for r in rows},
+        "rows": rows,
+        "suspects": suspects,
+        "measured_step_ms": measured_step,
+        "analytic_step_ms": analytic_step,
+        "comm_ms": comm_ms,
+        "total_drift_pct": (round((measured_step - analytic_step)
+                                  / analytic_step * 100.0, 1)
+                            if analytic_step > 0 else None),
+    }
+
+
+def format_reconcile(rec: dict) -> str:
+    """Human table for summarize_run's 'Measured vs analytic' section."""
+    lines = [f"  measured {rec['measured_step_ms']:.2f} ms/step vs "
+             f"analytic {rec['analytic_step_ms']:.2f} ms/step"
+             + (f" ({rec['total_drift_pct']:+.1f}%)"
+                if rec.get("total_drift_pct") is not None else "")
+             + f" over {rec['steps']} profiled step(s)"]
+    lines.append("  phase                 measured_ms  analytic_ms   drift")
+    for r in rec["rows"]:
+        d = ("      —" if r["drift_pct"] is None
+             else f"{r['drift_pct']:+6.1f}%")
+        lines.append(f"  {r['phase']:<21} {r['measured_ms']:11.3f}  "
+                     f"{r['analytic_ms']:11.3f}  {d}")
+    for s in rec["suspects"][:3]:
+        lines.append(f"  suspect: {s['phase']} — {s['gap_ms']:.3f} ms gap "
+                     f"({s['note']})")
+    return "\n".join(lines)

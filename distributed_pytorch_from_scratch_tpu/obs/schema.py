@@ -24,8 +24,10 @@ from typing import Dict, List, Tuple
 # event family. Version 3 = the ISSUE-12 live-telemetry family
 # (telemetry_snapshot / fleet_rollup / rotated continuations) plus the
 # cross-process request_trace fields (process, t0_wall, clock_offset_ms).
+# Version 4 = the ISSUE-15 measured-attribution family
+# (profile_attribution / hbm_watermark).
 # (Version 1 is retroactively "any pre-versioned event".)
-EVENT_SCHEMA_VERSION = 3
+EVENT_SCHEMA_VERSION = 4
 
 # tag -> fields a consumer may key on (presence contract, not types).
 # Only EVENT tags appear here — scalar ({"tag", "value", "step"}) and text
@@ -53,6 +55,18 @@ EVENT_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # size-based MetricsWriter rotation: the LAST record of a rotated-out
     # file names its continuation; tailers follow `next`
     "rotated": ("next",),
+    # -- ISSUE 15: the measured-attribution family -----------------------
+    # one parsed jax.profiler capture (training/metrics.py sampler paths
+    # via obs/profparse): consumers key on the capture dir, what armed it
+    # (duty / anomaly:<tag> / breakdown), and the measured phase-ms map
+    # (empty + `error` when the capture failed to parse — still an event,
+    # never a silent drop)
+    "profile_attribution": ("capture", "trigger", "phases"),
+    # live HBM watermark snapshot: `devices` is the per-device
+    # memory_stats list, EMPTY with available=false on a statless
+    # backend — the silent-zero fix exports 'unavailable' loudly instead
+    # of a fake 0-byte watermark
+    "hbm_watermark": ("devices", "available"),
 }
 
 

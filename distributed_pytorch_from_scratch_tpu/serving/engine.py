@@ -54,6 +54,33 @@ from .kv_manager import (KVCachePool, POOL_SPEC, PagedKVPool, PoolExhausted)
 from .scheduler import FIFOScheduler, SLOScheduler
 
 
+# ISSUE 15: HBM watermark cadences — gauges refresh every N decode steps
+# (a handful of host memory_stats() calls: cheap, but not per-step free;
+# the exporter overhead pin covers the gauge path), events land every M so
+# a metrics chain carries a bounded watermark series, plus the first step
+# so short runs still record one.
+_HBM_GAUGE_EVERY = 10
+_HBM_EVENT_EVERY = 100
+
+
+def _publish_hbm_plane(engine, pool_bytes=None) -> None:
+    """Shared per-engine HBM watermark publication (ISSUE 15): live
+    gauges into the exporter, `hbm_watermark` events into the metrics
+    chain, both on their cadence. `pool_bytes` is the paged pool's
+    ACCOUNTED page bytes — the pool-vs-device cross-check gauge."""
+    step = engine.decode_steps
+    gauge = engine.telemetry is not None and (
+        step == 1 or step % _HBM_GAUGE_EVERY == 0)
+    event = engine.writer is not None and (
+        step == 1 or step % _HBM_EVENT_EVERY == 0)
+    if not (gauge or event):
+        return
+    from ..training.metrics import publish_hbm
+    publish_hbm(telemetry=engine.telemetry if gauge else None,
+                writer=engine.writer if event else None, step=step,
+                pool_accounted_bytes=pool_bytes, event=event)
+
+
 def _setup_decode_weights(engine, model, mesh, params, decode_weight_dtype):
     """Shared weight-dtype plumbing for every engine: `engine._params_in`
     is what the compiled programs take (int8 codes + per-output-channel
@@ -197,7 +224,8 @@ class ContinuousBatchingEngine:
                  max_queue: int = 0, debug_host_sampler: bool = False,
                  decode_weight_dtype=None,
                  tracer=None, writer=None, request_tracer=None,
-                 flight=None, telemetry=None, clock=time.monotonic):
+                 flight=None, telemetry=None, duty_profiler=None,
+                 clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -225,6 +253,10 @@ class ContinuousBatchingEngine:
         self.rt = request_tracer        # obs.reqtrace.RequestTracer | None
         self.flight = flight            # obs.flight.FlightRecorder | None
         self.telemetry = telemetry      # obs.telemetry.TelemetryExporter
+        # ISSUE 15: optional training.metrics.DutyCycleProfiler — ticked
+        # once per decode step from the host loop (the thread owning the
+        # device queue), exactly like the flight recorder's anomaly tick
+        self.duty_profiler = duty_profiler
         self._dtype = resolve_dtype(model.cfg.compute_dtype)
         self._table_len = max(model.cfg.maxlen, buf_len)
         # sampling knobs kept on the engine: the fused in-program sampler
@@ -451,12 +483,16 @@ class ContinuousBatchingEngine:
             # `tok` is host-side already (the np.asarray above), so this
             # step's device work is done — safe profiler stop barrier
             self.flight.tick(self.decode_steps)
+        if self.duty_profiler is not None:
+            # same safe point: device work for this step is host-side
+            self.duty_profiler.tick(self.decode_steps)
         if self.telemetry is not None:
             tel = self.telemetry
             tel.gauge("serve/live", len(self._slot_req))
             tel.gauge("serve/queue_depth", self.scheduler.pending)
             tel.rate("serve/tokens_per_sec", self.generated_tokens)
             tel.counter("serve/decode_steps", self.decode_steps)
+        _publish_hbm_plane(self)
         for slot, req in list(self._slot_req.items()):
             # the pending token was written at `pos` by this dispatch: it
             # is now part of the output (mirrors make_generate's buf write)
@@ -575,7 +611,8 @@ class PagedEngine:
                  paged_attn_impl: str = "gather",
                  paged_attn_interpret: bool = False,
                  tracer=None, writer=None, request_tracer=None,
-                 flight=None, telemetry=None, clock=time.monotonic):
+                 flight=None, telemetry=None, duty_profiler=None,
+                 clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -614,6 +651,10 @@ class PagedEngine:
         self.rt = request_tracer        # obs.reqtrace.RequestTracer | None
         self.flight = flight            # obs.flight.FlightRecorder | None
         self.telemetry = telemetry      # obs.telemetry.TelemetryExporter
+        # ISSUE 15: optional training.metrics.DutyCycleProfiler — ticked
+        # once per decode step on the host loop (the flight recorder's
+        # anomaly-tick contract)
+        self.duty_profiler = duty_profiler
         # online per-class SLO accounting (ISSUE 12): {class: [completed,
         # hit]}, updated at every _complete — feeds the live exporter
         # gauges AND the in-run attainment-collapse flight trigger (the
@@ -644,6 +685,11 @@ class PagedEngine:
         self.kv_dtype = kv_dtype
         self.pool = PagedKVPool(model, mesh, num_pages, page_size,
                                 kv_dtype=kv_dtype, flight=flight)
+        # ISSUE 15: bytes one leased page costs, for the pool-vs-device
+        # HBM cross-check gauge (accounted pool bytes / measured
+        # bytes_in_use)
+        from .kv_manager import page_bytes
+        self._page_bytes_each = page_bytes(model.cfg, page_size, kv_dtype)
         self.scheduler = SLOScheduler(self.buf_len, classes=slo_classes,
                                       default_class=default_class,
                                       max_queue=max_queue, clock=clock,
@@ -1128,8 +1174,11 @@ class PagedEngine:
             # device work for this step is already host-side (`tok`);
             # safe point to drive an armed anomaly-profiler window
             self.flight.tick(self.decode_steps)
+        if self.duty_profiler is not None:
+            self.duty_profiler.tick(self.decode_steps)
         if self.telemetry is not None:
             self._publish_telemetry(used, live_tokens)
+        _publish_hbm_plane(self, pool_bytes=used * self._page_bytes_each)
         for slot, req in list(self._slot_req.items()):
             if self.rt is not None:
                 self.rt.mark(req, "decode", now)

@@ -219,6 +219,21 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "preemption, SLO collapse), cross-linked from "
                         "the dump's 'profile' field; needs "
                         "--flight_records; 0 = off")
+    g.add_argument("--profile_every", type=int, default=0, metavar="N",
+                   help="duty-cycled MEASURED attribution "
+                        "(training/metrics.DutyCycleProfiler): every N "
+                        "decode steps capture a --profile_window-step "
+                        "jax.profiler window, parse it (obs/profparse) "
+                        "and land a profile_attribution event in the "
+                        "--log_dir metrics chain; 0 = off (exactly zero "
+                        "cost: no captures, no events)")
+    g.add_argument("--profile_window", type=int, default=4, metavar="W",
+                   help="--profile_every: decode steps per capture "
+                        "window (must be <= N)")
+    g.add_argument("--profile_budget_mb", type=float, default=64.0,
+                   help="--profile_every: total on-disk capture budget; "
+                        "exhaustion stops sampling BETWEEN windows "
+                        "(never mid-window), counted in the summary")
     g.add_argument("--metrics_max_mb", type=float, default=0.0,
                    help="rotate metrics.jsonl past N MiB (-> "
                         "metrics.001.jsonl ... via schema-valid "
@@ -271,6 +286,23 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     if args.profile_on_anomaly and not args.flight_records:
         p.error("--profile_on_anomaly arms on flight-dump triggers; add "
                 "--flight_records")
+    if args.profile_every:
+        if args.profile_on_anomaly:
+            p.error("--profile_every excludes --profile_on_anomaly (both "
+                    "drive the one-capture-at-a-time device profiler; "
+                    "pick the duty cycle or the anomaly trigger)")
+        if not args.log_dir:
+            p.error("--profile_every needs a metrics dir: the parsed "
+                    "profile_attribution events land in --log_dir's "
+                    "metrics chain (point --log_dir somewhere writable)")
+        if not 1 <= args.profile_window <= args.profile_every:
+            p.error(f"--profile_window must be in [1, --profile_every] "
+                    f"(a window longer than the duty period would re-arm "
+                    f"mid-capture), got window {args.profile_window} with "
+                    f"every {args.profile_every}")
+        if args.profile_budget_mb <= 0:
+            p.error(f"--profile_budget_mb must be > 0, got "
+                    f"{args.profile_budget_mb}")
     if args.metrics_port is not None and args.metrics_port < 0:
         p.error(f"--metrics_port must be >= 0 (0 = ephemeral), got "
                 f"{args.metrics_port}")
@@ -370,10 +402,11 @@ def serve(args: argparse.Namespace) -> dict:
     from .loadgen import replay_requests, run_loadgen, synthetic_requests
 
     if args.trace_requests or args.flight_records \
-            or args.metrics_port is not None:
+            or args.metrics_port is not None or args.profile_every:
         require_writable_dir(
             args.log_dir,
-            "--trace_requests/--flight_records/--metrics_port")
+            "--trace_requests/--flight_records/--metrics_port/"
+            "--profile_every")
 
     eos_id = 1  # the shipped tokenizer's EOS (tokenizer/tokenizer.json)
     vocab_size = args.vocab_size
@@ -448,9 +481,16 @@ def serve(args: argparse.Namespace) -> dict:
         print(f"telemetry exporter: http://127.0.0.1:{port}/metrics.json "
               f"(Prometheus text at /metrics)", file=sys.stderr)
     profiler = (AnomalyProfiler(args.log_dir,
-                                window_steps=args.profile_on_anomaly)
+                                window_steps=args.profile_on_anomaly,
+                                writer=writer)
                 if args.profile_on_anomaly and args.flight_ring > 0
                 else None)
+    duty = None
+    if args.profile_every:
+        from ..training.metrics import DutyCycleProfiler
+        duty = DutyCycleProfiler(args.log_dir, args.profile_every,
+                                 args.profile_window,
+                                 args.profile_budget_mb, writer=writer)
     flight = (FlightRecorder(args.log_dir, maxlen=args.flight_ring,
                              profiler=profiler)
               if args.flight_records and args.flight_ring > 0 else None)
@@ -474,7 +514,8 @@ def serve(args: argparse.Namespace) -> dict:
                 slo_classes=parse_slo_classes(args.slo_classes),
                 default_class=args.default_class,
                 max_queue=args.queue_limit, tracer=tracer, writer=writer,
-                request_tracer=rt, flight=flight, telemetry=telemetry)
+                request_tracer=rt, flight=flight, telemetry=telemetry,
+                duty_profiler=duty)
             if args.speculate:
                 from .speculative import SpeculativeEngine
                 dmodel, dparams = _build_drafter(args, cfg.vocab_size, mesh,
@@ -499,14 +540,18 @@ def serve(args: argparse.Namespace) -> dict:
                 debug_host_sampler=args.debug_host_sampler,
                 decode_weight_dtype=wdtype,
                 tracer=tracer, writer=writer,
-                request_tracer=rt, flight=flight, telemetry=telemetry)
+                request_tracer=rt, flight=flight, telemetry=telemetry,
+                duty_profiler=duty)
         summary = run_loadgen(engine, requests)
     finally:
         # profiler before exporter before writer: an open capture window
-        # finalises, the exporter's LAST snapshot event lands, then the
-        # jsonl stream closes
+        # finalises (and parses into its profile_attribution event), the
+        # exporter's LAST snapshot event lands, then the jsonl stream
+        # closes
         if profiler is not None:
             profiler.close()
+        if duty is not None:
+            duty.close()
         if telemetry is not None:
             telemetry.close()
         path = tracer.close()
@@ -578,8 +623,19 @@ def serve(args: argparse.Namespace) -> dict:
             print(f"flight dump written: {d}", file=sys.stderr)
     if profiler is not None:
         rec["anomaly_profiles"] = list(profiler.captures)
+        rec["profile_attributions"] = profiler.attributions
         for d in profiler.captures:
             print(f"anomaly profile captured: {d}", file=sys.stderr)
+    if duty is not None:
+        rec["profile_captures"] = list(duty.captures)
+        rec["profile_attributions"] = duty.attributions
+        rec["profile_windows_skipped"] = duty.windows_skipped
+        print(f"duty profiler: {len(duty.captures)} capture(s), "
+              f"{duty.attributions} attributed, "
+              f"{duty.bytes_used / 2**20:.1f} MiB used"
+              + (f", {duty.windows_skipped} window(s) skipped after "
+                 f"budget exhaustion" if duty.windows_skipped else ""),
+              file=sys.stderr)
     print(json.dumps(rec))
     return summary
 

@@ -57,7 +57,8 @@ from ..config import resolve_dtype
 from ..models.decode import (_filter_logits, _full_vocab_logits,
                              _paged_decode_one, _paged_prefill_chunk,
                              rope_tables)
-from .engine import PagedEngine, Request, _chunk_maps, _pow2_at_most
+from .engine import (PagedEngine, Request, _chunk_maps, _pow2_at_most,
+                     _publish_hbm_plane)
 from .kv_manager import PagedKVPool, PoolExhausted, page_bytes
 
 # Randomness stream tags: every speculative draw folds
@@ -150,6 +151,10 @@ class SpeculativeEngine(PagedEngine):
         # share too, so the equal-HBM split stays one knob
         self.dpool = PagedKVPool(drafter_model, mesh, drafter_pages, ps,
                                  kv_dtype=self.kv_dtype)
+        # ISSUE 15: drafter pages count toward the accounted-HBM
+        # cross-check too (the equal-byte budget charges both pools)
+        self._drafter_page_bytes_each = page_bytes(drafter_model.cfg, ps,
+                                                   self.kv_dtype)
         self._dtbl = np.full((num_slots, self._d_max_pages),
                              self.dpool.scratch_page, np.int32)
         self._draft_fn = self._build_draft()
@@ -539,10 +544,17 @@ class SpeculativeEngine(PagedEngine):
             # the verify round's D2H already synced this step's device
             # work — safe point for an armed anomaly-profiler window
             self.flight.tick(self.decode_steps)
+        if self.duty_profiler is not None:
+            # same safe point (ISSUE 15): duty windows tick per verify
+            # round on the speculative engine
+            self.duty_profiler.tick(self.decode_steps)
         if self.telemetry is not None:
             self._publish_telemetry(used, live_tokens)
             self.telemetry.gauge("serve/drafter_pages_in_use",
                                  self.dpool.pages_in_use)
+        _publish_hbm_plane(
+            self, pool_bytes=used * self._page_bytes_each
+            + self.dpool.pages_in_use * self._drafter_page_bytes_each)
         for slot, req in list(self._slot_req.items()):
             na = int(n_acc[slot])
             n_att = min(k, int(qlen[slot]) - 1)

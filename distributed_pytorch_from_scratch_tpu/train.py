@@ -42,7 +42,7 @@ from .training.checkpoint import (latest_step, load_checkpoint,
                                   save_checkpoint)
 from .training.metrics import (MetricsWriter, ProfilerTrace,
                                chip_peak_flops, device_memory_gib,
-                               model_flops_per_step)
+                               model_flops_per_step, publish_hbm)
 from .training.optim import init_adam_state, schedule_lr
 from .training.train_step import (build_grad_accum_step, build_train_step,
                                   build_train_step_multi, resolve_zero_stage)
@@ -285,6 +285,22 @@ def get_train_args(argv=None) -> argparse.Namespace:
                         "when a flight dump fires (sentinel halt, watchdog "
                         "stall), cross-linked from the dump's 'profile' "
                         "field; needs --flight_ring > 0; 0 = off")
+    g.add_argument("--profile_every", type=int, default=0, metavar="N",
+                   help="duty-cycled MEASURED attribution "
+                        "(training/metrics.DutyCycleProfiler): every N "
+                        "dispatches capture a --profile_window-dispatch "
+                        "jax.profiler window, parse it (obs/profparse) "
+                        "and land a versioned profile_attribution event "
+                        "with the measured-vs-analytic reconcile; 0 = off "
+                        "(exactly zero cost: no captures, no events)")
+    g.add_argument("--profile_window", type=int, default=4, metavar="W",
+                   help="--profile_every: dispatches per capture window "
+                        "(must be <= N — a window longer than the duty "
+                        "period would re-arm mid-capture)")
+    g.add_argument("--profile_budget_mb", type=float, default=64.0,
+                   help="--profile_every: total on-disk capture budget; "
+                        "once exhausted, sampling stops BETWEEN windows "
+                        "(never mid-window) with a logged skip counter")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -315,6 +331,26 @@ def get_train_args(argv=None) -> argparse.Namespace:
         if args.rollup_interval <= 0:
             p.error("--rollup_interval must be > 0 (seconds between "
                     "telemetry_snapshot events)")
+    if args.profile_every:
+        # one jax.profiler capture at a time (ProfilerTrace's window
+        # mechanics): the duty sampler cannot share the device profiler
+        # with the fixed-window or anomaly-armed modes
+        if args.profile_steps:
+            p.error("--profile_every excludes --profile_steps (one "
+                    "jax.profiler capture window at a time; the duty "
+                    "sampler subsumes the fixed window)")
+        if args.profile_on_anomaly:
+            p.error("--profile_every excludes --profile_on_anomaly (both "
+                    "drive the one-capture-at-a-time device profiler; "
+                    "pick the duty cycle or the anomaly trigger)")
+        if not 1 <= args.profile_window <= args.profile_every:
+            p.error(f"--profile_window must be in [1, --profile_every] "
+                    f"(a window longer than the duty period would re-arm "
+                    f"mid-capture), got window {args.profile_window} with "
+                    f"every {args.profile_every}")
+        if args.profile_budget_mb <= 0:
+            p.error(f"--profile_budget_mb must be > 0, got "
+                    f"{args.profile_budget_mb}")
     return args
 
 
@@ -435,6 +471,7 @@ def train(args: argparse.Namespace) -> dict:
         spike_factor=args.sentinel_spike_factor,
         process_index=proc_idx, flight_ring=args.flight_ring,
         profile_on_anomaly=args.profile_on_anomaly)
+    duty = None  # DutyCycleProfiler, built once the model shape is known
 
     try:
         dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -685,6 +722,24 @@ def train(args: argparse.Namespace) -> dict:
         # profile a window shortly after start so compile+layout churn is over
         profiler = ProfilerTrace(logs_dir, start_step=start_step + 3,
                                  num_steps=args.profile_steps)
+        if args.profile_every:
+            # duty-cycled measured attribution (ISSUE 15): the analytic
+            # phase report this run is priced with rides along, so every
+            # parsed capture lands a full measured-vs-analytic reconcile
+            from .obs.attribution import attribution as _attr, chip_key_for
+            from .obs.profparse import analytic_phase_report
+            from .training.metrics import DutyCycleProfiler
+            chip = chip_key_for(jax.local_devices()[0].device_kind)
+            analytic = analytic_phase_report(_attr(
+                cfg, args.batch_size, maxlen, remat=remat_key,
+                family=args.family, tp=args.tp_size,
+                sp=args.sequence_parallel, tp_overlap=args.tp_overlap,
+                dp=args.dp_size, dp_bucket_mb=args.dp_reduce_bucket_mb,
+                dp_reduce_dtype=args.dp_reduce_dtype, chip=chip,
+                world=mesh_cfg.world_size, zero_stage=zero_stage))
+            duty = DutyCycleProfiler(
+                logs_dir, args.profile_every, args.profile_window,
+                args.profile_budget_mb, writer=writer, analytic=analytic)
         flops_step = model_flops_per_step(
             cfg, args.batch_size, maxlen,
             params=params if args.family == "gpt2" else None)
@@ -951,6 +1006,10 @@ def train(args: argparse.Namespace) -> dict:
                     steps_since += steps_in
                     observer.heartbeat(n, tokens=window["input_ids"].size,
                                        steps=steps_in, sync=loss)
+                    if duty is not None:
+                        # the duty window's start/stop boundaries; `loss`
+                        # is this dispatch's device value (stop barrier)
+                        duty.tick(n, sync=loss)
                     # only DISPATCHED pulls count toward the ms/dispatch wait
                     # metric (dropped partial groups and the end-of-epoch
                     # sentinel would deflate it)
@@ -973,16 +1032,28 @@ def train(args: argparse.Namespace) -> dict:
                         tps = tokens_since / max(dt, 1e-9)
                         useful = useful_since / max(tokens_since, 1)
                         mfu = (flops_step * steps_since) / max(dt, 1e-9) / peak_flops
+                        # None = the backend reports no memory_stats (CPU):
+                        # say so loudly; a 0.00 GiB watermark here misread
+                        # as "no HBM used" on every chip-less box (ISSUE 15)
+                        mem = device_memory_gib()
+                        mem_s = (f"{mem:.2f} GiB" if mem is not None
+                                 else "n/a (no memory stats)")
                         print(f"step {n}/{args.max_steps} -> avg loss {avg:.4f}, "
                               f"lr {float(lr):.8f}, {tps/1e3:.1f}k tok/s "
                               f"({useful*100:.0f}% useful), "
-                              f"MFU {mfu*100:.1f}%, mem {device_memory_gib():.2f} GiB")
+                              f"MFU {mfu*100:.1f}%, mem {mem_s}")
                         writer.scalar("train/ce_loss", avg, n)
                         writer.scalar("train/lr", float(lr), n)
                         writer.scalar("train/tokens_per_sec", tps, n)
                         writer.scalar("train/useful_token_frac", useful, n)
                         writer.scalar("train/mfu", mfu, n)
-                        writer.scalar("device_memory_gib", device_memory_gib(), n)
+                        if mem is not None:  # never export a fake 0
+                            writer.scalar("device_memory_gib", mem, n)
+                        # live HBM watermarks (ISSUE 15): per-device
+                        # gauges + one hbm_watermark event per interval
+                        # ('unavailable' exported loudly on CPU)
+                        publish_hbm(telemetry=telemetry, writer=writer,
+                                    step=n, event=True)
                         if gnorm is not None:
                             writer.scalar("train/grad_norm", gnorm, n)
                         if telemetry is not None:
@@ -1036,6 +1107,19 @@ def train(args: argparse.Namespace) -> dict:
                 prefetcher.close()
             shutdown.restore()
             join_save()
+            # duty profiler before the observer/writer: an open capture
+            # window finalises + parses into its profile_attribution
+            # event while the jsonl stream is still writable
+            if duty is not None:
+                duty.close()
+                if duty.captures or duty.windows_skipped:
+                    print(f"duty profiler: {len(duty.captures)} capture(s) "
+                          f"({duty.attributions} attributed, "
+                          f"{duty.bytes_used / 2**20:.1f} MiB of "
+                          f"{duty.budget_bytes / 2**20:.0f} MiB budget"
+                          + (f", {duty.windows_skipped} window(s) skipped "
+                             f"after budget exhaustion"
+                             if duty.windows_skipped else "") + ")")
             observer.close(print_summary=is_main)
             # exporter after the observer (its final snapshot is the
             # run's last registry state), before the writer it mirrors to
@@ -1058,6 +1142,8 @@ def train(args: argparse.Namespace) -> dict:
         # watchdog thread or the open trace/metrics handles when train()
         # is embedded (tests call it repeatedly). Both closes are
         # idempotent, so the happy path's finally running first is fine.
+        if duty is not None:
+            duty.close()
         observer.close(print_summary=False)
         if telemetry is not None:
             telemetry.close()

@@ -116,9 +116,16 @@ def estimate_step_gib(cfg, batch: int, seqlen: int, remat: str,
     return (fixed + acts + logits + opt_scratch) / 1024 ** 3
 
 
+_warned_assumed_budget = []
+
+
 def hbm_budget_gib(default: float = 16.0) -> float:
-    """Per-device HBM, from the live backend when one is attached (CPU test
-    meshes report none and fall back to `default`, the v5e figure)."""
+    """Per-device HBM, from the live backend when one is attached. A
+    backend with no `memory_stats()` (the CPU test mesh) falls back to
+    `default` (the v5e figure) — LOUDLY, once per process: a silently
+    assumed budget is the same silent-zero rot mode as the fake 0-GiB
+    watermark (ISSUE 15), and `--remat auto` decisions made on it must
+    be attributable to the assumption."""
     try:
         import jax
         dev = jax.local_devices()[0]
@@ -128,6 +135,13 @@ def hbm_budget_gib(default: float = 16.0) -> float:
             return limit / 1024 ** 3
     except Exception:  # noqa: BLE001 — sizing must never kill the caller
         pass
+    if not _warned_assumed_budget:
+        _warned_assumed_budget.append(True)
+        import sys
+        print(f"note: this backend reports no memory_stats — HBM budget "
+              f"UNAVAILABLE, assuming {default:g} GiB (v5e); remat/memory "
+              f"decisions sized against the assumption, not the chip",
+              file=sys.stderr)
     return default
 
 

@@ -13,7 +13,7 @@ import json
 import os
 import threading
 import time
-from typing import Optional
+from typing import List, Optional
 
 import jax
 
@@ -227,6 +227,174 @@ class ProfilerTrace:
                   f"steps than requested)", file=sys.stderr)
 
 
+def _dir_bytes(path: str) -> int:
+    """Recursive on-disk size of a capture dir (the duty sampler's disk
+    budget is charged per finished window)."""
+    total = 0
+    for dirpath, _, files in os.walk(path):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+            except OSError:
+                pass
+    return total
+
+
+def emit_profile_attribution(writer, capture_dir: str, trigger: str,
+                             steps: int, analytic=None) -> Optional[dict]:
+    """Parse a FINISHED capture dir (obs/profparse) and land it as one
+    versioned `profile_attribution` MetricsWriter event (ISSUE 15): the
+    measured phase taxonomy, and — when the caller supplies the analytic
+    phase report its run was priced with — the full measured-vs-analytic
+    reconcile. A capture that fails to parse still lands an event (with
+    `error` and empty phases): a window that silently vanished is the
+    rot mode the measured plane exists to kill. Returns the event's
+    fields (sans tag), or None when parsing failed."""
+    from ..obs import profparse
+    try:
+        measured = profparse.parse_capture(capture_dir)
+    except (ValueError, OSError) as e:
+        if writer is not None:
+            writer.event("profile_attribution", capture=capture_dir,
+                         trigger=trigger, steps=int(steps), phases={},
+                         error=f"{type(e).__name__}: {e}")
+        return None
+    fields = {
+        "capture": capture_dir,
+        "trigger": trigger,
+        "steps": int(steps),
+        "phases": profparse.phase_ms_map(measured),
+        "device_busy_ms": measured["device_busy_ms"],
+        "host_gap_ms": measured["host_gap_ms"],
+        "events": measured["events"],
+        "devices": measured["devices"],
+    }
+    if analytic is not None:
+        fields["reconcile"] = profparse.reconcile(measured, analytic,
+                                                  steps=steps)
+    if writer is not None:
+        writer.event("profile_attribution", **fields)
+    return fields
+
+
+class DutyCycleProfiler:
+    """Duty-cycled continuous device profiling (ISSUE 15): every `every`
+    dispatches, capture a bounded `jax.profiler` window of `window`
+    dispatches, parse it at stop (obs/profparse), and land a versioned
+    `profile_attribution` event — so a long run accumulates MEASURED
+    attribution points instead of one hand-triggered capture.
+
+    Same thread contract as `AnomalyProfiler`: `tick()` runs on the host
+    loop (the thread that owns the device queue) once per dispatch, and
+    reuses `ProfilerTrace`'s window mechanics (the stop blocks on `sync`
+    so a window never truncates). The disk budget (`budget_mb`) is
+    charged per FINISHED capture and checked only between windows — an
+    open window always completes ("never mid-window"); once the budget
+    is exhausted, further due windows are counted in `windows_skipped`
+    with a one-time loud note, and the run keeps going unprofiled.
+
+    The first window opens at the `every`-th tick, not the first — the
+    initial dispatches are compile/layout churn a steady-state
+    attribution must not average in."""
+
+    def __init__(self, log_dir: str, every: int, window: int = 4,
+                 budget_mb: float = 64.0, writer=None, analytic=None):
+        if every < 1:
+            raise ValueError(f"profile_every must be >= 1, got {every}")
+        if not 1 <= window <= every:
+            raise ValueError(
+                f"profile window must be in [1, profile_every] (a window "
+                f"longer than the duty period would re-arm mid-capture): "
+                f"got window {window}, every {every}")
+        if budget_mb <= 0:
+            raise ValueError(f"profile_budget_mb must be > 0, got "
+                             f"{budget_mb}")
+        if writer is None:
+            raise ValueError(
+                "duty-cycled profiling needs a MetricsWriter: the parsed "
+                "profile_attribution events ARE the product — a capture "
+                "nothing reads is the pre-ISSUE-15 state")
+        self.log_dir = log_dir
+        self.every = every
+        self.window = window
+        self.budget_bytes = int(budget_mb * 2**20)
+        self.writer = writer
+        self.analytic = analytic     # profparse.analytic_phase_report(...)
+        self._ticks = 0
+        self._trace: Optional[ProfilerTrace] = None
+        self._started_tick = 0
+        self._capture_no = 0
+        self.captures: List[str] = []       # capture dirs written
+        self.capture_steps: List[int] = []  # dispatches each one covered
+        self.attributions = 0               # events successfully parsed
+        self.windows_skipped = 0            # due windows past the budget
+        self.bytes_used = 0
+        self.exhausted = False
+
+    def tick(self, step: int = 0, sync=None) -> None:
+        """Once per dispatch from the host loop. `sync`: a device value
+        from this dispatch (the stop barrier). Window boundaries count
+        in TICKS (dispatches), not the caller's step numbers — a
+        steps_per_dispatch > 1 loop advances `step` by N per tick, and
+        pricing the window in that domain would close it N x early."""
+        if self._trace is not None:
+            self._trace.maybe_stop(self._ticks, sync=sync)
+            if self._trace._done:
+                self._finish(end_tick=self._ticks)
+        # not elif: a window finishing exactly on a duty boundary must
+        # not swallow the window due at that same tick — W == N means
+        # back-to-back capture, not half the documented cadence
+        if self._trace is None and self._ticks \
+                and self._ticks % self.every == 0:
+            if self.exhausted:
+                self.windows_skipped += 1
+            else:
+                self._start()
+        self._ticks += 1
+
+    def _start(self) -> None:
+        self._capture_no += 1
+        d = os.path.join(self.log_dir,
+                         f"profile_duty_{self._capture_no:03d}")
+        self._trace = ProfilerTrace(d, start_step=self._ticks,
+                                    num_steps=self.window)
+        self._started_tick = self._ticks
+        self._trace.maybe_start(self._ticks)
+        self.captures.append(self._trace.log_dir)
+
+    def _finish(self, end_tick: int) -> None:
+        trace, self._trace = self._trace, None
+        # the dispatches this capture ACTUALLY covered: a close()-forced
+        # window is shorter than `window`, and attributing it at the
+        # full count would deflate measured_step_ms (and the record the
+        # regression gate checks) by the truncation factor. `end_tick`
+        # is the last tick the window saw: the stop path passes the
+        # in-flight tick index; close() passes _ticks - 1 (the counter
+        # already advanced past the final dispatch).
+        steps = max(1, min(self.window, end_tick - self._started_tick))
+        self.capture_steps.append(steps)
+        self.bytes_used += _dir_bytes(trace.log_dir)
+        if self.bytes_used >= self.budget_bytes and not self.exhausted:
+            self.exhausted = True
+            import sys
+            print(f"duty profiler: disk budget exhausted after "
+                  f"{self._capture_no} capture(s) "
+                  f"({self.bytes_used / 2**20:.1f} MiB >= "
+                  f"{self.budget_bytes / 2**20:.1f} MiB) — sampling "
+                  f"stops; skipped windows are counted in the summary",
+                  file=sys.stderr)
+        if emit_profile_attribution(self.writer, trace.log_dir, "duty",
+                                    steps, self.analytic) is not None:
+            self.attributions += 1
+
+    def close(self, sync=None) -> None:
+        """Finish an open window at run end (shorter than requested beats
+        an unparsed truncated capture) and attribute it."""
+        if self._trace is not None:
+            self._trace.close(sync=sync)
+            self._finish(end_tick=self._ticks - 1)
+
+
 class AnomalyProfiler:
     """Anomaly-triggered device profiling (ISSUE 12): when a flight dump
     fires (sentinel halt, watchdog stall, PoolExhausted preemption, SLO
@@ -245,17 +413,28 @@ class AnomalyProfiler:
     armed by anomalies rather than always-on."""
 
     def __init__(self, log_dir: str, window_steps: int = 4,
-                 max_captures: int = 1):
+                 max_captures: int = 1, writer=None, analytic=None):
         if window_steps < 1:
             raise ValueError(f"profile window must be >= 1 step, got "
                              f"{window_steps}")
         self.log_dir = log_dir
         self.window_steps = window_steps
         self.max_captures = max_captures
+        # ISSUE 15: anomaly captures flow through the SAME parse as the
+        # duty sampler's — when a writer is attached, every finished
+        # window lands a profile_attribution event tagged with its
+        # anomaly trigger, so flight dumps cross-link an ATTRIBUTED
+        # timeline, not just a dir
+        self.writer = writer
+        self.analytic = analytic
+        self.attributions = 0
         self._lock = threading.Lock()
         self._pending = None          # (tag, capture_dir) awaiting a tick
         self._armed_total = 0
         self._trace: Optional[ProfilerTrace] = None  # tick-thread only
+        self._trace_tag: Optional[str] = None
+        self._trace_started = 0       # step the open window started at
+        self._last_step = 0           # the host loop's latest tick step
         self.captures = []            # capture dirs actually written
 
     def arm(self, tag: str) -> Optional[str]:
@@ -282,16 +461,35 @@ class AnomalyProfiler:
         with self._lock:
             pending = self._pending
             self._pending = None
+        self._last_step = step
         if pending is not None and self._trace is None:
             tag, path = pending
             self._trace = ProfilerTrace(path, start_step=step,
                                         num_steps=self.window_steps)
+            self._trace_tag = tag
+            self._trace_started = step
             self._trace.maybe_start(step)
             self.captures.append(self._trace.log_dir)
         elif self._trace is not None:
             self._trace.maybe_stop(step, sync=sync)
             if self._trace._done:
-                self._trace = None
+                self._attribute()
+
+    def _attribute(self) -> None:
+        """Parse the finished anomaly window into a profile_attribution
+        event (tick/close-thread only; no-op without a writer). The step
+        count is what the window ACTUALLY covered — a close()-forced
+        window is shorter than window_steps, and attributing it at the
+        full count would deflate the measured per-step ms."""
+        trace, self._trace = self._trace, None
+        tag, self._trace_tag = self._trace_tag, None
+        steps = max(1, min(self.window_steps,
+                           self._last_step - self._trace_started))
+        if self.writer is not None:
+            if emit_profile_attribution(
+                    self.writer, trace.log_dir, f"anomaly:{tag}",
+                    steps, self.analytic) is not None:
+                self.attributions += 1
 
     def close(self, sync=None) -> None:
         """Finish an open window at run end (shorter than requested beats
@@ -304,13 +502,16 @@ class AnomalyProfiler:
             pending = self._pending
             self._pending = None
         if pending is not None and self._trace is None:
-            _, path = pending
+            tag, path = pending
             self._trace = ProfilerTrace(path, start_step=0, num_steps=1)
+            self._trace_tag = tag
+            # never ticked: whatever close() captures counts as one step
+            self._trace_started = self._last_step
             self._trace.maybe_start(0)
             self.captures.append(self._trace.log_dir)
         if self._trace is not None:
             self._trace.close(sync=sync)
-            self._trace = None
+            self._attribute()
 
 
 def allreduce_p50_us(mesh, axis: str = "tp", nbytes: int = 4 * 1024 * 1024,
@@ -340,9 +541,13 @@ def allreduce_p50_us(mesh, axis: str = "tp", nbytes: int = 4 * 1024 * 1024,
     return times[len(times) // 2] * 1e6
 
 
-def device_memory_gib(device: Optional[jax.Device] = None) -> float:
-    """Bytes in use on the device, in GiB (analogue of
-    `torch.cuda.memory_reserved`, reference `train.py:119`)."""
+def device_memory_stats(device: Optional[jax.Device] = None) \
+        -> Optional[dict]:
+    """One device's `memory_stats()`, or **None when the backend has no
+    stats** (the CPU backend returns None; some platform backends raise).
+    Callers must render None as 'unavailable' — the pre-ISSUE-15 code
+    folded it into 0, exporting a fake 0-GiB watermark that reads as "this
+    run used no HBM" on every chip-less box (the silent-zero fix)."""
     if device is None:
         # local: in a multi-process run, jax.devices()[0] can belong to
         # another process — MemoryStats on a non-addressable device raises
@@ -350,7 +555,85 @@ def device_memory_gib(device: Optional[jax.Device] = None) -> float:
     try:
         stats = getattr(device, "memory_stats", lambda: None)()
     except Exception:  # platform backends without stats raise, not None
-        return 0.0
-    if not stats:
-        return 0.0
+        return None
+    return stats or None
+
+
+def device_memory_gib(device: Optional[jax.Device] = None) \
+        -> Optional[float]:
+    """Bytes in use on the device, in GiB (analogue of
+    `torch.cuda.memory_reserved`, reference `train.py:119`) — or None
+    when the backend reports no memory stats (say 'n/a', never 0)."""
+    stats = device_memory_stats(device)
+    if stats is None:
+        return None
     return stats.get("bytes_in_use", 0) / 1024 ** 3
+
+
+def hbm_watermarks() -> Optional[List[dict]]:
+    """Per-local-device HBM watermark snapshot (ISSUE 15): one dict per
+    addressable device with `bytes_in_use`, `peak_bytes` (the high-water
+    mark, when the backend tracks one) and `limit_bytes`. None when NO
+    local device reports stats — the unavailable case stays a distinct
+    value, not an all-zeros list."""
+    out = []
+    for d in jax.local_devices():
+        stats = device_memory_stats(d)
+        if stats is None:
+            continue
+        out.append({
+            "device": f"{d.platform}:{d.id}",
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes": int(stats.get("peak_bytes_in_use",
+                                        stats.get("bytes_in_use", 0))),
+            "limit_bytes": int(stats.get("bytes_limit")
+                               or stats.get("bytes_reservable_limit") or 0),
+        })
+    return out or None
+
+
+def publish_hbm(telemetry=None, writer=None, step: Optional[int] = None,
+                pool_accounted_bytes: Optional[int] = None,
+                event: bool = False) -> Optional[List[dict]]:
+    """Publish live HBM watermark gauges (and optionally one
+    `hbm_watermark` event) from `memory_stats()` (ISSUE 15).
+
+    Gauges: `hbm/available` (0/1 — an unavailable backend is exported
+    LOUDLY as 0-available, never as 0 bytes), and when available
+    `hbm/bytes_in_use` / `hbm/peak_bytes` / `hbm/limit_bytes` (worst
+    local device — the watermark that OOMs first) plus per-device
+    `hbm/d<i>/...` gauges. `pool_accounted_bytes` (the PagedKVPool's
+    pages_in_use x page_bytes) rides as `hbm/kv_accounted_bytes` and the
+    `hbm/kv_accounted_frac` cross-check — accounted pool bytes over
+    measured bytes-in-use; a fraction drifting toward 0 while the pool
+    thinks it is full means something else is eating the device.
+
+    Returns the per-device snapshot (None when unavailable) so callers
+    can reuse it without a second stats round."""
+    marks = hbm_watermarks()
+    if telemetry is not None:
+        telemetry.gauge("hbm/available", 0.0 if marks is None else 1.0)
+        if marks is not None:
+            telemetry.gauge("hbm/bytes_in_use",
+                            max(m["bytes_in_use"] for m in marks))
+            telemetry.gauge("hbm/peak_bytes",
+                            max(m["peak_bytes"] for m in marks))
+            telemetry.gauge("hbm/limit_bytes",
+                            max(m["limit_bytes"] for m in marks))
+            for i, m in enumerate(marks):
+                telemetry.gauge(f"hbm/d{i}/bytes_in_use", m["bytes_in_use"])
+                telemetry.gauge(f"hbm/d{i}/peak_bytes", m["peak_bytes"])
+        if pool_accounted_bytes is not None:
+            telemetry.gauge("hbm/kv_accounted_bytes", pool_accounted_bytes)
+            if marks is not None:
+                in_use = max(m["bytes_in_use"] for m in marks)
+                if in_use:
+                    telemetry.gauge("hbm/kv_accounted_frac",
+                                    pool_accounted_bytes / in_use)
+    if event and writer is not None:
+        fields = {"devices": marks or [],
+                  "available": marks is not None}
+        if pool_accounted_bytes is not None:
+            fields["pool_accounted_bytes"] = int(pool_accounted_bytes)
+        writer.event("hbm_watermark", step=step, **fields)
+    return marks

@@ -49,6 +49,14 @@ LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95")
 # the decode roofline (e.g. the pallas arm silently fell back to gather,
 # or the gather view grew), whatever tokens/s happened to measure
 BYTES_FIELDS = ("decode_hbm_bytes_per_step",)
+# MEASURED attribution (ISSUE 15): when both records carry a
+# measured_vs_analytic reconcile (bench --profile_every / the breakdown
+# --capture_profile), the measured per-step device ms and the measured
+# collective ms are strictly directional too — up = fail, whatever the
+# analytic model claims. Per-phase measured ms are compared dynamically
+# below (the phase set depends on what the capture saw).
+MEASURED_FIELDS = ("measured_vs_analytic.measured_step_ms",
+                   "measured_vs_analytic.comm_ms")
 
 
 def load_record(path):
@@ -136,6 +144,17 @@ def metric_checks(fresh, base, tol_pct, tol_latency_pct):
             fields.append((f, "down", tol_latency_pct))
         for f in BYTES_FIELDS:
             fields.append((f, "down", tol_latency_pct))
+    # measured attribution (both units): aggregate measured ms, plus one
+    # dynamic check per phase BOTH captures measured — a phase only one
+    # side saw is skipped visibly like any absent field
+    for f in MEASURED_FIELDS:
+        fields.append((f, "down", tol_latency_pct))
+    fp = _get(fresh, "measured_vs_analytic.phases")
+    bp = _get(base, "measured_vs_analytic.phases")
+    if isinstance(fp, dict) and isinstance(bp, dict):
+        for phase in sorted(set(fp) & set(bp)):
+            fields.append((f"measured_vs_analytic.phases.{phase}",
+                           "down", tol_latency_pct))
     checks, skipped = [], []
     for field, direction, tol in fields:
         fv, bv = _get(fresh, field), _get(base, field)

@@ -76,13 +76,22 @@ def render(collector, rollup: dict) -> str:
                      + (f"; PERSISTENT: "
                         f"{', '.join('p%d' % x for x in skew['persistent'])}"
                         if skew["persistent"] else ""))
-    lines.append("| source | tok/s | live | queue | pages | slo |")
-    lines.append("|---|---|---|---|---|---|")
+    hbm = rollup.get("hbm")
+    if hbm:
+        line = (f"HBM: {hbm['bytes_in_use_total'] / 2**30:.2f} GiB in use "
+                f"across {hbm['procs_reporting']} proc(s), peak "
+                f"{hbm['peak_bytes_max'] / 2**30:.2f} GiB")
+        if hbm.get("procs_unavailable"):
+            line += (f"; {hbm['procs_unavailable']} proc(s) report NO "
+                     f"memory stats (not zero — unavailable)")
+        lines.append(line)
+    lines.append("| source | tok/s | live | queue | pages | hbm | slo |")
+    lines.append("|---|---|---|---|---|---|---|")
     for key, state in sorted(collector.procs.items()):
         snap = state.get("telemetry_snapshot")
         if snap is None:
             lines.append(f"| {os.path.basename(key)} | (no snapshot yet; "
-                         f"post-hoc events only) | | | | |")
+                         f"post-hoc events only) | | | | | |")
             continue
         g = snap.get("gauges", {})
         tps = g.get("serve/tokens_per_sec",
@@ -91,12 +100,21 @@ def render(collector, rollup: dict) -> str:
             f"{n.split('/')[1]} {100 * v:.0f}%"
             for n, v in sorted(g.items())
             if n.startswith("slo/") and n.endswith("/attained")) or "-"
+        # the watermark column says 'n/a' on statless backends — never a
+        # fake 0 (the ISSUE-15 silent-zero contract, fleet-rendered)
+        if "hbm/available" not in g:
+            hbm_col = "-"
+        elif not g["hbm/available"]:
+            hbm_col = "n/a"
+        else:
+            hbm_col = (f"{g.get('hbm/bytes_in_use', 0) / 2**30:.2f}"
+                       f"/{g.get('hbm/peak_bytes', 0) / 2**30:.2f}G")
         lines.append(
             f"| {os.path.basename(key)} | {tps:.0f} "
             f"| {g.get('serve/live', g.get('train/step', 0)):.0f} "
             f"| {g.get('serve/queue_depth', 0):.0f} "
             f"| {g.get('serve/pages_in_use', 0):.0f}"
-            f"/{g.get('serve/num_pages', 0):.0f} | {slo} |")
+            f"/{g.get('serve/num_pages', 0):.0f} | {hbm_col} | {slo} |")
     tails = sum(t.records for t in collector._tailers.values())
     invalid = sum(t.invalid for t in collector._tailers.values())
     lines.append(f"({tails} records folded"

@@ -310,6 +310,73 @@ def crossproc_lines(rdir):
     return rows
 
 
+def measured_lines(rdir):
+    """'Measured vs analytic' (ISSUE 15): `profile_attribution` events —
+    parsed jax.profiler captures from the duty-cycled sampler, the
+    anomaly profiler, and the bench capture paths — rendered with their
+    per-phase measured ms and, when the producer attached the analytic
+    reconcile, the drift table naming the worst 'model is wrong here'
+    suspects. Renders next to the roofline numbers the bench lines
+    report, so an analytic claim and its on-device check read together."""
+    rows = []
+    for rel, rec in _iter_events(rdir, ("profile_attribution",)):
+        if rec.get("error"):
+            rows.append(f"- `{rel}` [{rec.get('trigger')}] capture "
+                        f"`{rec.get('capture')}`: UNPARSEABLE — "
+                        f"{rec['error']}")
+            continue
+        phases = rec.get("phases") or {}
+        steps = max(int(rec.get("steps", 1)), 1)
+        top = ", ".join(f"{k} {v / steps:.2f}ms"
+                        for k, v in sorted(phases.items(),
+                                           key=lambda kv: -kv[1])[:4])
+        rows.append(f"- `{rel}` [{rec.get('trigger')}] "
+                    f"{rec.get('events', '?')} device events over "
+                    f"{steps} step(s): {top or '(no phases)'} "
+                    f"(capture `{rec.get('capture')}`)")
+        rc = rec.get("reconcile")
+        if rc:
+            try:
+                import sys
+                repo = os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))
+                if repo not in sys.path:
+                    sys.path.insert(0, repo)
+                from distributed_pytorch_from_scratch_tpu.obs.profparse \
+                    import format_reconcile
+                rows.extend("  " + ln
+                            for ln in format_reconcile(rc).splitlines())
+            except (ImportError, KeyError) as e:
+                rows.append(f"  (reconcile present but unrenderable: {e})")
+    return rows
+
+
+def hbm_lines(rdir):
+    """Peak-HBM watermarks from `hbm_watermark` events (ISSUE 15): the
+    last event per metrics file — and a LOUD 'unavailable' line for
+    statless backends, which previous rounds rendered as a fake 0 GiB."""
+    last = {}
+    for rel, rec in _iter_events(rdir, ("hbm_watermark",)):
+        last[rel] = rec
+    rows = []
+    for rel, rec in sorted(last.items()):
+        if not rec.get("available"):
+            rows.append(f"- `{rel}`: HBM stats UNAVAILABLE on this "
+                        f"backend (not zero — unmeasured)")
+            continue
+        devs = rec.get("devices") or []
+        peak = max((d.get("peak_bytes", 0) for d in devs), default=0)
+        in_use = sum(d.get("bytes_in_use", 0) for d in devs)
+        line = (f"- `{rel}`: peak {peak / 2**30:.2f} GiB, "
+                f"{in_use / 2**30:.2f} GiB in use across "
+                f"{len(devs)} device(s)")
+        if rec.get("pool_accounted_bytes") is not None:
+            line += (f"; KV pool accounts "
+                     f"{rec['pool_accounted_bytes'] / 2**20:.1f} MiB")
+        rows.append(line)
+    return rows
+
+
 def fleet_lines(rdir):
     """`fleet_rollup` events (obs/collector.py via scripts/obs_top.py):
     the fleet-level view a live collector computed during the run."""
@@ -538,6 +605,17 @@ def summarize(rdir):
         out.append("Cross-process request waterfalls (merged after "
                    "clock-offset translation):")
         out.extend(crossproc)
+    measured = measured_lines(rdir)
+    if measured:
+        out.append("")
+        out.append("Measured vs analytic (obs v4: parsed jax.profiler "
+                   "captures, profile_attribution events):")
+        out.extend(measured)
+    hbm = hbm_lines(rdir)
+    if hbm:
+        out.append("")
+        out.append("HBM watermarks (hbm_watermark events):")
+        out.extend(hbm)
     fleet = fleet_lines(rdir)
     if fleet:
         out.append("")

@@ -191,6 +191,26 @@ CORE_LANE = {
         "test_serve_dry_run_with_telemetry_and_profiler",
         "test_bench_telemetry_flags_gated_on_serving",
     ],
+    # obs v4 (ISSUE 15): the committed-fixture round-trip pin (parse +
+    # hand-math reconcile), the taxonomy, the silent-zero HBM pins, the
+    # schema-v4/collector/obs_top coverage, the gate's measured
+    # direction, and the CLI refusals — all pure host, no compiles; the
+    # real-capture end-to-end + duty-cycle-law tests (tiny compiles /
+    # a dry-run serve) stay in the default lane
+    "test_measured_attribution.py": [
+        "test_fixture_capture_parses_to_hand_checked_phases",
+        "test_fixture_reconcile_drift_hand_math",
+        "test_classify_op_taxonomy",
+        "test_device_memory_unavailable_is_none_not_zero",
+        "test_publish_hbm_exports_unavailable_loudly",
+        "test_schema_v4_profile_attribution_and_hbm_watermark",
+        "test_fleet_rollup_folds_hbm_and_keeps_unavailable_distinct",
+        "test_obs_top_once_renders_hbm_column",
+        "test_gate_measured_ms_directional",
+        "test_serve_cli_profile_refusals",
+        "test_bench_cli_profile_refusals",
+        "test_train_cli_profile_refusals",
+    ],
     "test_obs_v2.py": [
         "test_paged_request_timelines_contiguous_and_sum_to_wall",
         "test_flight_ring_bound_holds_under_sustained_load",

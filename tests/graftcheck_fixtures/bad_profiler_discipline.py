@@ -1,0 +1,14 @@
+"""BAD: raw jax.profiler.start_trace/stop_trace in a loop module — this
+races the one-capture-at-a-time window mechanics ProfilerTrace owns; a
+concurrent armed window's stop would truncate THIS capture (or vice
+versa) into an unparseable dir."""
+import jax
+
+
+def profile_some_steps(step_fn, state, log_dir):
+    jax.profiler.start_trace(log_dir)      # scattered start!
+    for _ in range(4):
+        state = step_fn(state)
+    jax.block_until_ready(state)
+    jax.profiler.stop_trace()              # scattered stop!
+    return state

@@ -1,0 +1,18 @@
+"""GOOD: captures driven through the training/metrics.py owners — the
+window opens/closes inside ProfilerTrace's mechanics, so stops block on
+the sync value and never race another capture."""
+import jax
+
+from distributed_pytorch_from_scratch_tpu.training.metrics import (
+    ProfilerTrace)
+
+
+def profile_some_steps(step_fn, state, log_dir):
+    trace = ProfilerTrace(log_dir, start_step=0, num_steps=4)
+    for step in range(5):
+        trace.maybe_start(step)
+        state = step_fn(state)
+        trace.maybe_stop(step, sync=state)
+    jax.block_until_ready(state)
+    trace.close(sync=state)
+    return state

@@ -1,0 +1,70 @@
+"""Regenerate the committed fixture capture for tests/test_measured_attribution.py.
+
+The fixture is a synthetic `jax.profiler` capture dir — the real
+`plugins/profile/<ts>/*.trace.json.gz` layout with a HAND-AUTHORED event
+set whose per-phase totals are pinned exactly by the tests:
+
+    fusion   10.0 ms (8 + 2)          dot        2.0 ms
+    all-reduce 3.0 ms                 collective-permute 1.0 ms
+    copy      0.5 ms                  transpose  0.5 ms
+    convert   1.0 ms
+    busy = 18.0 ms, lane span 0..20 ms  ->  host_gap = 2.0 ms
+
+One python host-callstack event (no hlo args) rides along and must be
+ignored. Written with a deterministic gzip (mtime=0) so regeneration is
+byte-stable. Run from the repo root:
+
+    python tests/profparse_fixtures/gen_fixture.py
+"""
+
+import gzip
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "capture", "plugins", "profile",
+                   "2026_01_01_00_00_00")
+
+PID, TID = 7, 42
+
+
+def ev(name, ts, dur, hlo=True):
+    e = {"ph": "X", "pid": PID, "tid": TID, "ts": float(ts),
+         "dur": float(dur), "name": name}
+    if hlo:
+        e["args"] = {"hlo_module": "jit_step", "hlo_op": name}
+    return e
+
+
+DOC = {
+    "displayTimeUnit": "ns",
+    "traceEvents": [
+        {"ph": "M", "pid": PID, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": PID, "tid": TID, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        ev("fusion.1", 0, 8000),
+        ev("fusion.2", 8000, 2000),
+        ev("dot.3", 10000, 2000),
+        ev("all-reduce.1", 12000, 3000),
+        ev("collective-permute.2", 15000, 1000),
+        ev("copy.5", 16000, 500),
+        ev("transpose.1", 16500, 500),
+        ev("convert.9", 19000, 1000),
+        # host event without hlo args: the parser must skip it
+        ev("$train.py:100 run_step", 0, 20000, hlo=False),
+    ],
+}
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    path = os.path.join(OUT, "fixture.trace.json.gz")
+    payload = json.dumps(DOC, sort_keys=True).encode()
+    with open(path, "wb") as f:
+        f.write(gzip.compress(payload, mtime=0))
+    print(f"wrote {path} ({len(payload)} bytes uncompressed)")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,10 @@ R5 = os.path.join(REPO, "runs", "r5")
 # r14 the live telemetry plane: exported serving + collector rollup +
 # the SLO-collapse anomaly arm with cross-linked device profiling,
 # r15 the paged-attention kernel: pages_per_block autotune + the
-# gather-vs-pallas A/B sweep with int8 and speculative arms)
+# gather-vs-pallas A/B sweep with int8 and speculative arms,
+# r16 measured attribution: duty-cycled profiled train window, the
+# measured breakdown + profiled serving bench arms, the anomaly capture
+# that parses, and the measured-ms regression gate)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -41,7 +44,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r12"),
                             os.path.join(REPO, "runs", "r13"),
                             os.path.join(REPO, "runs", "r14"),
-                            os.path.join(REPO, "runs", "r15"))
+                            os.path.join(REPO, "runs", "r15"),
+                            os.path.join(REPO, "runs", "r16"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS

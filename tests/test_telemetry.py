@@ -644,6 +644,14 @@ def test_train_run_exports_telemetry(tmp_path):
     assert last["gauges"]["train/tokens_per_sec"] > 0
     assert "train/goodput" in last["gauges"]
     assert last["counters"]["train/step"] == 10
+    # ISSUE 15 silent-zero pin: the CPU backend has no memory_stats, so
+    # the run must export 'unavailable' loudly — no device_memory_gib
+    # scalar (previously a fake 0), hbm/available gauge at 0, and
+    # hbm_watermark events saying available=false
+    assert not any(r.get("tag") == "device_memory_gib" for r in recs)
+    assert last["gauges"].get("hbm/available") == 0.0
+    hw = [r for r in recs if r["tag"] == "hbm_watermark"]
+    assert hw and all(r["available"] is False for r in hw)
     # the collector reads a train fleet too
     c = FleetCollector([os.path.join(save, "logs")])
     c.poll()
@@ -701,6 +709,13 @@ def test_exported_traced_overhead_within_budget(tmp_path):
         times[exported] = best
         steps[exported] = max((eng.decode_steps - s0) // 3, 1)
         if tel is not None:
+            # ISSUE 15: the watermark gauges ride the same publish path,
+            # so this pin now also bounds THEIR marginal cost — and on
+            # the statless CPU backend they must export 'unavailable',
+            # never a fake 0-byte gauge
+            g = tel.snapshot()["gauges"]
+            assert g.get("hbm/available") == 0.0
+            assert "hbm/bytes_in_use" not in g
             tel.close()
         w.close()
     ratio = times[True] / times[False]
