@@ -250,6 +250,14 @@ def parse_args(argv=None):
                    help="--profile_every: total on-disk capture budget; "
                         "exhaustion stops sampling between windows, "
                         "never mid-window")
+    p.add_argument("--control", choices=["off", "advise"], default="off",
+                   help="--serving + --profile_every: run the obs v5 "
+                        "drift advisor in ADVISE mode over the paged "
+                        "arm's duty reconciles — tuning_decision ledger "
+                        "events land in --obs_dir and the record carries "
+                        "the summary. 'act' is deliberately absent: a "
+                        "bench record must measure ONE fixed config, not "
+                        "a config that moved mid-measurement")
     p.add_argument("--capture_profile", action="store_true",
                    help="--breakdown: capture the scanned multi-step "
                         "program under a jax.profiler window "
@@ -312,6 +320,9 @@ def parse_args(argv=None):
         if args.profile_budget_mb <= 0:
             p.error(f"--profile_budget_mb must be > 0, got "
                     f"{args.profile_budget_mb}")
+    if args.control != "off" and not args.profile_every:
+        p.error("--control advise rides the duty profiler's measured "
+                "reconciles; add --profile_every N (a --serving knob)")
     if args.capture_profile:
         if not args.breakdown:
             p.error("--capture_profile is a --breakdown knob (the "
@@ -637,7 +648,7 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     # dir cannot take writes (a silently traceless traced bench is worse
     # than none)
     obs_tracer = obs_writer = obs_rt = obs_flight = None
-    obs_telemetry = obs_profiler = obs_duty = None
+    obs_telemetry = obs_profiler = obs_duty = obs_advisor = None
     if args.trace_requests or args.flight_records \
             or args.metrics_port is not None or args.profile_every:
         from distributed_pytorch_from_scratch_tpu.obs import (
@@ -685,6 +696,24 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         # the impl the engine actually built (a non-TPU backend downgrades
         # 'pallas' to 'gather' with a warning — the record must not lie)
         paged_attn = paged.paged_attn_impl
+        if args.control != "off" and obs_duty is not None:
+            # obs v5 ADVISE-mode drift advisor on the paged arm: the duty
+            # hook below fires between capture windows (the registered
+            # safe point); advise never mutates, so the record still
+            # measures exactly the configured engine
+            from distributed_pytorch_from_scratch_tpu.obs.control import (
+                RetuneAdvisor, control_safe_point)
+            obs_advisor = RetuneAdvisor(args.control, writer=obs_writer,
+                                        telemetry=obs_telemetry)
+            obs_advisor.register_knob(
+                "prefill_chunk", lambda: paged.prefill_chunk, lo=1)
+
+            @control_safe_point
+            def _bench_on_attribution(fields):
+                obs_advisor.observe_attribution(fields)
+                obs_advisor.apply_decisions()
+
+            obs_duty.on_attribution = _bench_on_attribution
         paged_summary = run_loadgen(paged, burst())
         paged_rate = paged_summary["tokens_per_sec"]
     finally:
@@ -695,6 +724,8 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             obs_profiler.close()
         if obs_duty is not None:
             obs_duty.close()
+        if obs_advisor is not None:  # after duty: its close() may feed
+            obs_advisor.close()      # the advisor one last reconcile
         if obs_telemetry is not None:
             obs_telemetry.close()
         if obs_tracer is not None:
@@ -990,6 +1021,10 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
            if obs_duty is not None else {}),
         **({"measured_vs_analytic": measured_vs_analytic}
            if measured_vs_analytic is not None else {}),
+        # ISSUE 16: the advise-mode ledger summary (absent when off —
+        # the zero-cost off-state the tests pin)
+        **({"control": args.control, "tuning": obs_advisor.summary()}
+           if obs_advisor is not None else {}),
         **spec_rec,
         "ttft_ms_p50": paged_summary["ttft_ms_p50"],
         "ttft_ms_p95": paged_summary["ttft_ms_p95"],

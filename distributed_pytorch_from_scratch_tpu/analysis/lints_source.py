@@ -260,6 +260,63 @@ def check_profiler_discipline(src: SourceFile) -> List[Violation]:
     return out
 
 
+# ---------------------------------------------------- controller-discipline --
+
+#: the control plane's owner modules: the advisor/controller INTERNALS may
+#: touch actuation freely (they are the mechanism the rule protects)
+_CONTROL_OWNERS = ("obs/control.py", "serving/controller.py")
+_ACTUATION_CALLS = {"apply_decisions", "actuate"}
+_SAFE_POINT_DECO = "control_safe_point"
+
+
+def _deco_tail(d: ast.AST) -> str:
+    """`@control_safe_point` / `@control.control_safe_point` -> the bare
+    decorator name (calls unwrap to their func)."""
+    if isinstance(d, ast.Call):
+        d = d.func
+    return (dotted(d) or "").split(".")[-1]
+
+
+@rule("controller-discipline",
+      "controller/advisor actuation outside a control_safe_point function",
+      "the obs-v5 control plane mutates live engine knobs "
+      "(pages_per_block, prefill chunk, speculation K); an actuation "
+      "from an arbitrary call site lands mid-capture-window or inside a "
+      "traced function, which tears the measurement the decision was "
+      "based on — actuation is only legal at registered safe points "
+      "(engine init boundaries, the host-side control tick, between "
+      "duty-cycle capture windows)")
+def check_controller_discipline(src: SourceFile) -> List[Violation]:
+    path = src.path.replace(os.sep, "/")
+    if any(path.endswith(owner) for owner in _CONTROL_OWNERS):
+        return []
+    # every node living inside a @control_safe_point function is blessed
+    safe_ids: set = set()
+    for node in src.nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_deco_tail(d) == _SAFE_POINT_DECO
+                   for d in node.decorator_list):
+                for sub in ast.walk(node):
+                    safe_ids.add(id(sub))
+    out: List[Violation] = []
+    for node in src.nodes:
+        if not isinstance(node, ast.Call) or id(node) in safe_ids:
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in _ACTUATION_CALLS:
+            out.append(Violation(
+                "controller-discipline", src.path, node.lineno,
+                f"{name}() outside a @control_safe_point function — "
+                f"knob actuation from an arbitrary call site can land "
+                f"mid-capture-window or inside a traced function; move "
+                f"the call into a registered safe point (the engine's "
+                f"control tick, a duty-profiler on_attribution hook, or "
+                f"an init boundary)"))
+    return out
+
+
 # ---------------------------------------------------------- lock-discipline --
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",

@@ -1,9 +1,11 @@
 """Observability: step-timeline tracing, goodput accounting, compiled-
 program introspection, a training-health sentinel, a hang watchdog,
 (v2, ISSUE 10) per-request tracing, an anomaly flight recorder, and
-cross-rank skew attribution, and (v3, ISSUE 12) the live telemetry
+cross-rank skew attribution, (v3, ISSUE 12) the live telemetry
 plane: per-process exporters, the fleet collector, cross-process trace
-propagation, and anomaly-triggered device profiling.
+propagation, and anomaly-triggered device profiling, and (v5, ISSUE 16)
+the control plane: drift-driven retuning with an auditable decision
+ledger.
 
 See docs/OBSERVABILITY.md for the operator's view (trace format, goodput
 buckets, sentinel thresholds, flight-dump walkthrough, live endpoints).
@@ -12,6 +14,8 @@ buckets, sentinel thresholds, flight-dump walkthrough, live endpoints).
 from .attribution import (attribution, flash_tile_stats, format_attribution,
                           rank_skew)
 from .collector import FleetCollector, JsonlTailer
+from .control import (CONTROL_MODES, Knob, RetuneAdvisor,
+                      control_safe_point)
 from .profparse import (analytic_phase_report, format_reconcile,
                         parse_capture, reconcile)
 from .flight import FlightRecorder
@@ -27,11 +31,12 @@ from .trace import SpanTracer
 from .watchdog import HangWatchdog
 
 __all__ = [
-    "BUCKETS", "EVENT_REQUIRED", "EVENT_SCHEMA_VERSION", "FleetCollector",
-    "FlightRecorder", "GoodputMeter", "HangWatchdog", "HealthSentinel",
-    "JsonlTailer", "RequestTracer", "SpanTracer", "TelemetryExporter",
-    "TraceContext", "TrainObserver", "TrainingHealthError",
-    "analytic_phase_report", "analyze_compiled", "attribution",
+    "BUCKETS", "CONTROL_MODES", "EVENT_REQUIRED", "EVENT_SCHEMA_VERSION",
+    "FleetCollector", "FlightRecorder", "GoodputMeter", "HangWatchdog",
+    "HealthSentinel", "JsonlTailer", "Knob", "RequestTracer",
+    "RetuneAdvisor", "SpanTracer", "TelemetryExporter", "TraceContext",
+    "TrainObserver", "TrainingHealthError", "analytic_phase_report",
+    "analyze_compiled", "attribution", "control_safe_point",
     "flash_tile_stats", "fleet_slo_attainment", "format_analysis",
     "format_attribution", "format_reconcile", "merge_traces",
     "parse_capture", "parse_collectives", "rank_skew", "reconcile",

@@ -162,7 +162,8 @@ class FleetCollector:
 
     # -- folding ----------------------------------------------------------
     _KEEP_TAGS = ("telemetry_snapshot", "serving_summary", "paged_kv_stats",
-                  "rank_phase_stats", "goodput_summary", "hbm_watermark")
+                  "rank_phase_stats", "goodput_summary", "hbm_watermark",
+                  "tuning_decision", "controller_decision")
 
     def poll(self) -> int:
         """One collection pass: tail every discovered file and scrape
@@ -274,6 +275,28 @@ class FleetCollector:
         # fleet fact, never a 0-byte proc folded into the sum.
         hbm_in_use = hbm_peak = 0
         hbm_procs = hbm_unavailable = 0
+        # control plane (ISSUE 16): per-proc mode/decision gauges + the
+        # freshest folded ledger event -> fleet controller state. A proc
+        # that never published ctl/mode counts as off — pre-v5 streams
+        # produce no block at all (the rollup shape is unchanged)
+        ctl_modes = {"advise": 0, "act": 0}
+        ctl_decisions = 0
+        ctl_last = None
+        for state in procs.values():
+            snap = state.get("telemetry_snapshot")
+            if snap is not None:
+                g = snap.get("gauges", {})
+                m = g.get("ctl/mode")
+                if m is not None and 0 <= int(m) < 3:
+                    mode = ("off", "advise", "act")[int(m)]
+                    if mode in ctl_modes:
+                        ctl_modes[mode] += 1
+                    ctl_decisions += int(g.get("ctl/decisions", 0))
+            d = (state.get("controller_decision")
+                 or state.get("tuning_decision"))
+            if d is not None and (ctl_last is None
+                                  or d.get("t", 0) >= ctl_last.get("t", 0)):
+                ctl_last = d
         for state in procs.values():
             snap = state.get("telemetry_snapshot")
             if snap is not None:
@@ -326,6 +349,21 @@ class FleetCollector:
                 "procs_reporting": hbm_procs,
                 "procs_unavailable": hbm_unavailable,
             }
+        if any(ctl_modes.values()) or ctl_last is not None:
+            out["control"] = {
+                "procs": {**ctl_modes,
+                          "off": len(procs) - sum(ctl_modes.values())},
+                "decisions": ctl_decisions,
+            }
+            if ctl_last is not None:
+                out["control"]["last"] = {
+                    "tag": ctl_last.get("tag"),
+                    "knob": ctl_last.get("knob"),
+                    "old": ctl_last.get("old"),
+                    "new": ctl_last.get("new"),
+                    "mode": ctl_last.get("mode"),
+                    "applied": ctl_last.get("applied"),
+                }
         if len(skew_recs) >= 2:
             try:
                 from .attribution import rank_skew

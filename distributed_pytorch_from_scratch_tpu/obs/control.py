@@ -1,0 +1,271 @@
+"""The control plane (obs v5, ISSUE 16): drift-driven retuning with an
+auditable decision ledger.
+
+Obs v4 can say *where the analytic model is wrong* (the duty-cycled
+measured-vs-analytic reconcile) and *how much HBM is left* (live
+watermarks); every knob those signals implicate — `pages_per_block`,
+prefill chunk, dp bucket MiB, speculative K — was still set by hand from
+offline sweeps. This module closes the loop, under one discipline:
+**every actuation is itself a first-class observable.** A knob never
+moves without a versioned `tuning_decision` event recording what moved,
+from what to what, and the evidence (per-phase drift ms, HBM headroom,
+the capture id) that justified it.
+
+The `--control {off,advise,act}` ladder:
+
+* `off`    — the plane does not exist: no advisor, no events, no record
+  fields (the zero-cost off-state the test suite pins byte-for-byte);
+* `advise` — decisions are computed and landed in the ledger with
+  `applied: false`; nothing mutates;
+* `act`    — decisions queue at proposal time and mutate ONLY inside
+  `apply_decisions()`, which callers invoke from a registered safe
+  point: a function decorated with `@control_safe_point` (engine init
+  boundaries, between capture windows, the engine's host-side decode
+  tick — never mid-window, never inside a traced function). graftcheck's
+  `controller-discipline` rule enforces the decoration statically.
+
+Deliberately jax-free (the schema.py convention): the advisor consumes
+already-parsed event fields and actuates through caller-supplied
+knob setters, so it imports from standalone scripts and tests without
+touching a backend.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Dict, List, Optional
+
+from .profparse import COLLECTIVE_KINDS
+
+CONTROL_MODES = ("off", "advise", "act")
+MODE_INDEX = {m: i for i, m in enumerate(CONTROL_MODES)}
+
+
+def control_safe_point(fn):
+    """Mark `fn` as a registered control-plane safe point: a call site
+    allowed to invoke `apply_decisions()`/`actuate()`. The decoration is
+    the registration — graftcheck's `controller-discipline` rule flags
+    actuation calls from any undecorated function. Identity at runtime
+    (no wrapper: safe points sit on host hot paths)."""
+    fn.__control_safe_point__ = True
+    return fn
+
+
+class Knob:
+    """One tunable the control plane may move: a getter, an optional
+    setter (None = an init-boundary knob — its decisions are recorded
+    but land only at the next engine init, e.g. dp bucket MiB baked
+    into the compiled step), and clamp bounds."""
+
+    def __init__(self, name: str, getter: Callable[[], float],
+                 setter: Optional[Callable[[float], None]] = None,
+                 lo: Optional[float] = None, hi: Optional[float] = None,
+                 integer: bool = True):
+        self.name = name
+        self.getter = getter
+        self.setter = setter
+        self.lo = lo
+        self.hi = hi
+        self.integer = integer
+
+    def clamp(self, v: float) -> float:
+        if self.lo is not None:
+            v = max(self.lo, v)
+        if self.hi is not None:
+            v = min(self.hi, v)
+        return int(round(v)) if self.integer else float(v)
+
+
+class RetuneAdvisor:
+    """Drift-driven retuning: consume duty-cycled `profile_attribution`
+    reconciles and `hbm_watermark` events, emit `tuning_decision` ledger
+    events, and (mode=act) move registered knobs at safe points.
+
+    The rules are deliberately small, directional, and evidenced — the
+    advisor is a closed measurement loop, not an optimizer:
+
+    * collective drift >= `drift_pct` -> grow `dp_bucket_mb` (x2, seeded
+      at 4.0 from 0 — unbucketed): the wire is costing more than priced,
+      bucketing amortizes latency per launch;
+    * measured `copy` phase >= `copy_frac` of the step -> grow
+      `pages_per_block` (x2): gather/scatter traffic the paged kernel's
+      block fetch amortizes;
+    * measured `host_gap` >= `host_gap_frac` of the step -> grow
+      `prefill_chunk` (x2): fewer, larger host dispatches;
+    * `compute` drift >= `drift_pct` -> shrink `speculate_k` (-1): the
+      draft work costs more than the roofline priced it at;
+    * HBM headroom < `hbm_headroom_frac` -> halve `pages_per_block` and
+      `prefill_chunk`: working-set pressure beats throughput tuning.
+
+    A knob re-proposes only when the target value changes (no event spam
+    from a persistent signal), and an act-mode proposal queues until
+    `apply_decisions()` runs from a `@control_safe_point` call site.
+    """
+
+    def __init__(self, mode: str, writer=None, telemetry=None,
+                 drift_pct: float = 25.0, copy_frac: float = 0.10,
+                 host_gap_frac: float = 0.20,
+                 hbm_headroom_frac: float = 0.10):
+        if mode not in CONTROL_MODES:
+            raise ValueError(f"control mode must be one of "
+                             f"{CONTROL_MODES}, got {mode!r}")
+        self.mode = mode
+        self.writer = writer
+        self.telemetry = telemetry
+        self.drift_pct = drift_pct
+        self.copy_frac = copy_frac
+        self.host_gap_frac = host_gap_frac
+        self.hbm_headroom_frac = hbm_headroom_frac
+        self.knobs: Dict[str, Knob] = {}
+        self.decisions: List[dict] = []      # the emitted ledger, in order
+        self.last_headroom: Optional[float] = None
+        self._pending: List[tuple] = []      # (knob, decision) awaiting act
+        self._last_proposed: Dict[str, float] = {}
+        self._seq = 0
+        if telemetry is not None and mode != "off":
+            telemetry.gauge("ctl/mode", MODE_INDEX[mode])
+
+    def register_knob(self, name: str, getter, setter=None, lo=None,
+                      hi=None, integer: bool = True) -> None:
+        self.knobs[name] = Knob(name, getter, setter, lo, hi, integer)
+
+    # -- observation (proposal) rules ---------------------------------
+    def observe_attribution(self, fields: Optional[dict]) -> List[dict]:
+        """Consume one parsed capture's `profile_attribution` fields
+        (the DutyCycleProfiler `on_attribution` hook — i.e. between
+        capture windows). Returns the decisions proposed."""
+        if self.mode == "off" or not fields:
+            return []
+        rec = fields.get("reconcile")
+        if not rec:
+            return []
+        capture = fields.get("capture")
+        rows = {r["phase"]: r for r in rec.get("rows", [])}
+        step_ms = float(rec.get("measured_step_ms") or 0.0)
+        out = []
+        comm = [r for r in rows.values()
+                if r["phase"] in COLLECTIVE_KINDS
+                and r.get("drift_pct") is not None
+                and r["drift_pct"] >= self.drift_pct]
+        if comm:
+            ev = {"capture": capture, "trigger": "comm_drift",
+                  "phases": {r["phase"]: {
+                      "measured_ms": r["measured_ms"],
+                      "analytic_ms": r["analytic_ms"],
+                      "drift_pct": r["drift_pct"]} for r in comm}}
+            out += self._propose("dp_bucket_mb",
+                                 lambda old: old * 2 if old else 4.0, ev)
+        copy = rows.get("copy")
+        if copy and step_ms > 0 \
+                and copy["measured_ms"] >= self.copy_frac * step_ms:
+            ev = {"capture": capture, "trigger": "copy_traffic",
+                  "copy_ms": copy["measured_ms"], "step_ms": step_ms}
+            out += self._propose("pages_per_block", lambda old: old * 2,
+                                 ev)
+        gap = rows.get("host_gap")
+        if gap and step_ms > 0 \
+                and gap["measured_ms"] >= self.host_gap_frac * step_ms:
+            ev = {"capture": capture, "trigger": "host_gap",
+                  "host_gap_ms": gap["measured_ms"], "step_ms": step_ms}
+            out += self._propose("prefill_chunk", lambda old: old * 2, ev)
+        comp = rows.get("compute")
+        if comp and comp.get("drift_pct") is not None \
+                and comp["drift_pct"] >= self.drift_pct:
+            ev = {"capture": capture, "trigger": "compute_drift",
+                  "drift_pct": comp["drift_pct"]}
+            out += self._propose("speculate_k", lambda old: old - 1, ev)
+        return out
+
+    def observe_hbm(self, fields: Optional[dict]) -> List[dict]:
+        """Consume one `hbm_watermark` event's fields. Low headroom
+        shrinks the working-set knobs."""
+        if self.mode == "off" or not fields or not fields.get("available"):
+            return []
+        rooms = [(d["limit_bytes"] - d["bytes_in_use"]) / d["limit_bytes"]
+                 for d in fields.get("devices", ())
+                 if d.get("limit_bytes")]
+        if not rooms:
+            return []
+        self.last_headroom = min(rooms)
+        if self.last_headroom >= self.hbm_headroom_frac:
+            return []
+        ev = {"trigger": "hbm_pressure",
+              "hbm_headroom_frac": round(self.last_headroom, 4),
+              "devices": len(fields.get("devices", ()))}
+        out = []
+        for name in ("pages_per_block", "prefill_chunk"):
+            out += self._propose(name, lambda old: old // 2, dict(ev))
+        return out
+
+    # -- the ledger ----------------------------------------------------
+    def _propose(self, name: str, fn, evidence: dict) -> List[dict]:
+        knob = self.knobs.get(name)
+        if knob is None:
+            return []
+        old = knob.getter()
+        new = knob.clamp(fn(old))
+        if new == old or self._last_proposed.get(name) == new:
+            return []
+        self._last_proposed[name] = new
+        self._seq += 1
+        d = {"knob": name, "old": old, "new": new,
+             "evidence": evidence, "mode": self.mode, "seq": self._seq}
+        if self.mode == "act":
+            self._pending.append((knob, d))
+        else:
+            d["applied"] = False
+            self._emit(d)
+        return [d]
+
+    def _emit(self, d: dict) -> None:
+        self.decisions.append(d)
+        if self.writer is not None:
+            self.writer.event("tuning_decision", **d)
+        if self.telemetry is not None:
+            self.telemetry.gauge("ctl/decisions", len(self.decisions))
+        print(f"control[{self.mode}]: {d['knob']} {d['old']} -> "
+              f"{d['new']} ({d['evidence'].get('trigger')}"
+              + ("" if d["applied"] else "; not applied") + ")",
+              file=sys.stderr)
+
+    def apply_decisions(self) -> int:
+        """Actuate every queued act-mode decision. MUST be called from a
+        `@control_safe_point` function (graftcheck-enforced); returns
+        how many knobs actually moved. An init-boundary knob (no
+        setter) and a refused cache write land in the ledger with
+        `applied: false` plus the reason — a decision that could not
+        act is still a decision."""
+        applied = 0
+        while self._pending:
+            knob, d = self._pending.pop(0)
+            if knob.setter is None:
+                d["applied"] = False
+                d["note"] = ("init-boundary knob: recorded; lands at "
+                             "the next engine init")
+            else:
+                try:
+                    knob.setter(d["new"])
+                    d["applied"] = True
+                    applied += 1
+                except ValueError as e:   # e.g. a refused cache shadow
+                    d["applied"] = False
+                    d["error"] = str(e)
+            self._emit(d)
+        return applied
+
+    def close(self) -> None:
+        """Flush act-mode proposals that never reached a safe point —
+        an unapplied decision must still reach the ledger."""
+        while self._pending:
+            _, d = self._pending.pop(0)
+            d["applied"] = False
+            d["note"] = "unapplied at run end (no safe point reached)"
+            self._emit(d)
+
+    def summary(self) -> dict:
+        """Record-field summary (serve.py/train.py stdout records —
+        added only when the mode is not off, the zero-cost-off rule)."""
+        last = self.decisions[-1] if self.decisions else None
+        return {"mode": self.mode, "decisions": len(self.decisions),
+                "applied": sum(1 for d in self.decisions if d["applied"]),
+                "last_knob": last["knob"] if last else None}

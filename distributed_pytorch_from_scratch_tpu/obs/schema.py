@@ -26,8 +26,11 @@ from typing import Dict, List, Tuple
 # cross-process request_trace fields (process, t0_wall, clock_offset_ms).
 # Version 4 = the ISSUE-15 measured-attribution family
 # (profile_attribution / hbm_watermark).
+# Version 5 = the ISSUE-16 control-plane family: the decision ledger
+# (tuning_decision / controller_decision) every --control advise/act
+# actuation lands in.
 # (Version 1 is retroactively "any pre-versioned event".)
-EVENT_SCHEMA_VERSION = 4
+EVENT_SCHEMA_VERSION = 5
 
 # tag -> fields a consumer may key on (presence contract, not types).
 # Only EVENT tags appear here — scalar ({"tag", "value", "step"}) and text
@@ -67,6 +70,18 @@ EVENT_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # backend — the silent-zero fix exports 'unavailable' loudly instead
     # of a fake 0-byte watermark
     "hbm_watermark": ("devices", "available"),
+    # -- ISSUE 16: the control-plane / decision-ledger family ------------
+    # one RetuneAdvisor proposal (obs/control.py): which knob, old->new,
+    # the evidence that justified it (per-phase drift ms, HBM headroom,
+    # capture id), whether the run was allowed to act on it, and whether
+    # it actually did (applied=false under --control advise)
+    "tuning_decision": ("knob", "old", "new", "evidence", "mode",
+                        "applied"),
+    # one online SLO/admission adaptation (serving/controller.py):
+    # cross-linked to the telemetry snapshot that triggered it via
+    # `snapshot_seq`, so the ledger can replay trigger -> action
+    "controller_decision": ("knob", "old", "new", "trigger", "mode",
+                            "applied", "snapshot_seq"),
 }
 
 

@@ -209,6 +209,18 @@ class TelemetryExporter:
                           counters=snap["counters"],
                           process=snap["process"])
 
+    def emit_snapshot(self) -> int:
+        """Land ONE snapshot event now and return its 1-based sequence
+        number (how many this process has emitted, in stream order) —
+        the control plane's cross-link: a `controller_decision` stores
+        this as `snapshot_seq`, so the post-hoc ledger joins the
+        decision to the exact registry state that triggered it (ISSUE
+        16). 0 when there is no writer to land the event in."""
+        if self.writer is None:
+            return 0
+        self._emit_snapshot()
+        return self.snapshots
+
     def close(self) -> None:
         """Stop the threads, then land ONE final snapshot event (a run's
         last registry state is the one the post-hoc reader wants — the

@@ -534,7 +534,18 @@ class BlockConfig:
 _BLOCK_TABLE: Dict[Tuple[int, int, str, str], BlockConfig] = {
     (1024, 64, "bfloat16", "tpu"): BlockConfig(1024, 1024, 1024, 1024),
 }
+# key -> {source: sweep|online, capture, ts} provenance (ISSUE 16): an
+# online retune must never silently shadow a swept entry
+_BLOCK_META: Dict[Tuple[int, int, str, str], dict] = {}
 _cache_loaded = False
+
+
+def _parse_cache_key(parts):
+    return (int(parts[0]), int(parts[1]), parts[2], parts[3])
+
+
+def _parse_cache_cfg(blocks):
+    return BlockConfig(*(int(b) for b in blocks))
 
 
 def block_cache_path() -> str:
@@ -554,13 +565,31 @@ def load_block_cache(path: Optional[str] = None) -> int:
     from .block_cache import load_json_table
     return load_json_table(
         path or block_cache_path(), _BLOCK_TABLE,
-        lambda parts: (int(parts[0]), int(parts[1]), parts[2], parts[3]),
-        lambda blocks: BlockConfig(*(int(b) for b in blocks)))
+        _parse_cache_key, _parse_cache_cfg, meta=_BLOCK_META)
 
 
 def save_block_cache(path: Optional[str] = None) -> str:
     from .block_cache import save_json_table
-    return save_json_table(path or block_cache_path(), _BLOCK_TABLE)
+    return save_json_table(path or block_cache_path(), _BLOCK_TABLE,
+                           meta=_BLOCK_META)
+
+
+def record_online_block_config(t: int, head_dim: int, dtype,
+                               config: BlockConfig,
+                               capture: Optional[str] = None,
+                               force: bool = False,
+                               path: Optional[str] = None) -> str:
+    """Adopt an ONLINE-retuned flash block shape: set it in-memory and
+    persist it with {source: online, capture, ts} provenance (ISSUE 16).
+    Refuses (ValueError) to shadow a swept cache entry without `force`."""
+    from .block_cache import write_online_entry
+    key = _table_key(t, head_dim, dtype)
+    out = write_online_entry(path or block_cache_path(), key, config,
+                             _parse_cache_key, _parse_cache_cfg,
+                             capture=capture, force=force)
+    _BLOCK_TABLE[key] = config
+    _BLOCK_META[key] = {"source": "online", "capture": capture, "ts": None}
+    return out
 
 
 def set_block_config(t: int, head_dim: int, dtype,

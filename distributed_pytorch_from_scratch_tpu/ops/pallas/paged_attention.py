@@ -331,7 +331,17 @@ class PagedBlockConfig:
 
 # (page_size, head_dim, kv_dtype_name, backend) -> PagedBlockConfig
 _PAGED_TABLE: Dict[Tuple[int, int, str, str], PagedBlockConfig] = {}
+# key -> {source: sweep|online, capture, ts} provenance (ISSUE 16)
+_PAGED_META: Dict[Tuple[int, int, str, str], dict] = {}
 _cache_loaded = False
+
+
+def _parse_cache_key(parts):
+    return (int(parts[0]), int(parts[1]), parts[2], parts[3])
+
+
+def _parse_cache_cfg(blocks):
+    return PagedBlockConfig(*(int(x) for x in blocks))
 
 
 def paged_block_cache_path() -> str:
@@ -357,12 +367,31 @@ def load_paged_block_cache(path: Optional[str] = None) -> int:
     Garbled files are ignored (defaults still apply)."""
     return load_json_table(
         path or paged_block_cache_path(), _PAGED_TABLE,
-        lambda parts: (int(parts[0]), int(parts[1]), parts[2], parts[3]),
-        lambda blocks: PagedBlockConfig(*(int(x) for x in blocks)))
+        _parse_cache_key, _parse_cache_cfg, meta=_PAGED_META)
 
 
 def save_paged_block_cache(path: Optional[str] = None) -> str:
-    return save_json_table(path or paged_block_cache_path(), _PAGED_TABLE)
+    return save_json_table(path or paged_block_cache_path(), _PAGED_TABLE,
+                           meta=_PAGED_META)
+
+
+def record_online_paged_config(page_size: int, head_dim: int, kv_dtype,
+                               config: PagedBlockConfig,
+                               capture: Optional[str] = None,
+                               force: bool = False,
+                               path: Optional[str] = None) -> str:
+    """Adopt an ONLINE-retuned pages_per_block: set it in-memory (the
+    next dispatch reads it — a host-side table, no retrace) and persist
+    it with {source: online, capture, ts} provenance (ISSUE 16).
+    Refuses (ValueError) to shadow a swept cache entry without `force`."""
+    from .block_cache import write_online_entry
+    key = _table_key(page_size, head_dim, kv_dtype)
+    out = write_online_entry(path or paged_block_cache_path(), key, config,
+                             _parse_cache_key, _parse_cache_cfg,
+                             capture=capture, force=force)
+    _PAGED_TABLE[key] = config
+    _PAGED_META[key] = {"source": "online", "capture": capture, "ts": None}
+    return out
 
 
 def set_paged_block_config(page_size: int, head_dim: int, kv_dtype,

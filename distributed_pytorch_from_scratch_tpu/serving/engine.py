@@ -49,6 +49,7 @@ from ..models.decode import (_decode_one, _paged_decode_one,
                              host_sample_tokens, make_token_sampler,
                              rope_tables)
 from ..config import resolve_dtype
+from ..obs.control import control_safe_point
 from ..ops.quant import dequantize_decode_params, quantize_decode_params
 from .kv_manager import (KVCachePool, POOL_SPEC, PagedKVPool, PoolExhausted)
 from .scheduler import FIFOScheduler, SLOScheduler
@@ -612,7 +613,7 @@ class PagedEngine:
                  paged_attn_interpret: bool = False,
                  tracer=None, writer=None, request_tracer=None,
                  flight=None, telemetry=None, duty_profiler=None,
-                 clock=time.monotonic):
+                 controller=None, clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
                 "the serving engine decodes on the cp=1 path (per-slot "
@@ -655,6 +656,9 @@ class PagedEngine:
         # once per decode step on the host loop (the flight recorder's
         # anomaly-tick contract)
         self.duty_profiler = duty_profiler
+        # ISSUE 16: optional serving.controller.SLOController — observed
+        # and actuated only from _control_tick (the registered safe point)
+        self.controller = controller
         # online per-class SLO accounting (ISSUE 12): {class: [completed,
         # hit]}, updated at every _complete — feeds the live exporter
         # gauges AND the in-run attainment-collapse flight trigger (the
@@ -1179,6 +1183,8 @@ class PagedEngine:
         if self.telemetry is not None:
             self._publish_telemetry(used, live_tokens)
         _publish_hbm_plane(self, pool_bytes=used * self._page_bytes_each)
+        if self.controller is not None:
+            self._control_tick()
         for slot, req in list(self._slot_req.items()):
             if self.rt is not None:
                 self.rt.mark(req, "decode", now)
@@ -1195,6 +1201,18 @@ class PagedEngine:
                 self._complete(req, done)
             else:
                 self._tokens[slot] = cand
+
+    @control_safe_point
+    def _control_tick(self) -> None:
+        """The control plane's registered safe point (ISSUE 16): device
+        work for this decode step is already host-side (the same
+        contract as flight.tick above), nothing is traced, and no
+        capture window is mid-flight on this thread — so the SLO
+        controller may observe AND (mode=act) actuate here. graftcheck's
+        `controller-discipline` rule pins that `apply_decisions` is only
+        ever called from a `@control_safe_point` function."""
+        self.controller.tick(self.decode_steps)
+        self.controller.apply_decisions()
 
     def _publish_telemetry(self, pages_used: int, live_tokens: int) -> None:
         """Per-decode-step exporter update (ISSUE 12): a handful of lock-

@@ -239,6 +239,26 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "metrics.001.jsonl ... via schema-valid "
                         "'rotated' continuation events; consumers "
                         "follow the chain); 0 = unbounded")
+    g.add_argument("--control", choices=["off", "advise", "act"],
+                   default="off",
+                   help="the obs v5 control plane (obs/control.py, "
+                        "serving/controller.py): 'advise' computes SLO/"
+                        "admission and drift-retune decisions and lands "
+                        "them in the decision ledger with applied=false "
+                        "(nothing mutates); 'act' additionally moves the "
+                        "knobs — prefill chunk, admission limit, "
+                        "speculation K, pages_per_block — at registered "
+                        "safe points only. 'off' (default) is zero-cost: "
+                        "no advisor, no events, no record fields")
+    g.add_argument("--control_interval", type=int, default=32,
+                   help="--control: decode steps between SLO-controller "
+                        "evaluations (the adaptation + cooldown window)")
+    g.add_argument("--control_force", action="store_true",
+                   help="--control act: let an online pages_per_block "
+                        "retune overwrite a SWEPT block-cache entry "
+                        "(default: the write is refused and the decision "
+                        "lands applied=false with the refusal — online "
+                        "never silently shadows a sweep)")
 
     g = p.add_argument_group("other")
     g.add_argument("--log_dir", default="serve_logs",
@@ -303,6 +323,18 @@ def get_serve_args(argv=None) -> argparse.Namespace:
         if args.profile_budget_mb <= 0:
             p.error(f"--profile_budget_mb must be > 0, got "
                     f"{args.profile_budget_mb}")
+    if args.control != "off":
+        if not args.paged:
+            p.error("--control drives the paged engine's scheduler "
+                    "admission and prefill chunking (the slot engine has "
+                    "none of those knobs); add --paged")
+        if args.control_interval < 1:
+            p.error(f"--control_interval must be >= 1, got "
+                    f"{args.control_interval}")
+    if args.control_force and args.control != "act":
+        p.error("--control_force needs --control act (only act mode "
+                "writes the block cache; nothing can shadow a swept "
+                "entry otherwise)")
     if args.metrics_port is not None and args.metrics_port < 0:
         p.error(f"--metrics_port must be >= 0 (0 = ephemeral), got "
                 f"{args.metrics_port}")
@@ -480,6 +512,11 @@ def serve(args: argparse.Namespace) -> dict:
         port = telemetry.start(args.metrics_port)
         print(f"telemetry exporter: http://127.0.0.1:{port}/metrics.json "
               f"(Prometheus text at /metrics)", file=sys.stderr)
+    elif args.control != "off":
+        # headless registry (no HTTP endpoint): controller decisions
+        # cross-link a telemetry_snapshot emitted at decision time, so
+        # the control plane needs the registry even without --metrics_port
+        telemetry = TelemetryExporter(writer=writer)
     profiler = (AnomalyProfiler(args.log_dir,
                                 window_steps=args.profile_on_anomaly,
                                 writer=writer)
@@ -497,6 +534,7 @@ def serve(args: argparse.Namespace) -> dict:
     rt = (RequestTracer(writer=writer, tracer=tracer, flight=flight,
                         clock=_time.monotonic)
           if args.trace_requests else None)
+    controller = advisor = None
     try:
         kv_dtype = None if args.kv_dtype == "native" else args.kv_dtype
         wdtype = (None if args.decode_weight_dtype == "native"
@@ -542,6 +580,62 @@ def serve(args: argparse.Namespace) -> dict:
                 tracer=tracer, writer=writer,
                 request_tracer=rt, flight=flight, telemetry=telemetry,
                 duty_profiler=duty)
+        if args.control != "off":
+            from ..obs.control import RetuneAdvisor, control_safe_point
+            from .controller import SLOController
+            controller = SLOController(engine, args.control, writer=writer,
+                                       telemetry=telemetry,
+                                       interval=args.control_interval)
+            # the engine's decorated _control_tick (its host-side decode
+            # tick) is the safe point that drives tick()+apply_decisions()
+            engine.controller = controller
+            if duty is not None:
+                # drift-driven retuning rides the duty profiler: the
+                # on_attribution hook fires BETWEEN capture windows (a
+                # registered safe point), with the parsed reconcile
+                advisor = RetuneAdvisor(args.control, writer=writer,
+                                        telemetry=telemetry)
+                advisor.register_knob(
+                    "prefill_chunk",
+                    lambda: engine.prefill_chunk,
+                    lambda v: setattr(engine, "prefill_chunk", int(v)),
+                    lo=1)
+                if args.speculate:
+                    advisor.register_knob(
+                        "speculate_k", lambda: engine.k,
+                        lambda v: setattr(engine, "k", int(v)), lo=1)
+                last_capture = {"id": None}
+                if args.paged_attn == "pallas":
+                    from ..ops.pallas.paged_attention import (
+                        PagedBlockConfig, get_paged_block_config,
+                        record_online_paged_config)
+                    hd = cfg.attn_dim // cfg.num_heads
+                    kvd = (None if args.kv_dtype == "native"
+                           else args.kv_dtype)
+                    advisor.register_knob(
+                        "pages_per_block",
+                        lambda: get_paged_block_config(
+                            args.page_size, hd, kvd).pages_per_block,
+                        lambda v: record_online_paged_config(
+                            args.page_size, hd, kvd,
+                            PagedBlockConfig(int(v)),
+                            capture=last_capture["id"],
+                            force=args.control_force),
+                        lo=1)
+
+                @control_safe_point
+                def _on_attribution(fields):
+                    # between capture windows: observe, then actuate —
+                    # the decoration is the graftcheck registration
+                    last_capture["id"] = (fields or {}).get("capture")
+                    advisor.observe_attribution(fields)
+                    from ..training.metrics import hbm_watermarks
+                    marks = hbm_watermarks()
+                    advisor.observe_hbm({"devices": marks or [],
+                                         "available": marks is not None})
+                    advisor.apply_decisions()
+
+                duty.on_attribution = _on_attribution
         summary = run_loadgen(engine, requests)
     finally:
         # profiler before exporter before writer: an open capture window
@@ -552,6 +646,13 @@ def serve(args: argparse.Namespace) -> dict:
             profiler.close()
         if duty is not None:
             duty.close()
+        # control plane after the duty profiler (its close() can finalise
+        # a window and hand the advisor one last reconcile) and before
+        # the exporter/writer (ledger flushes are events)
+        if advisor is not None:
+            advisor.close()
+        if controller is not None:
+            controller.close()
         if telemetry is not None:
             telemetry.close()
         path = tracer.close()
@@ -614,9 +715,15 @@ def serve(args: argparse.Namespace) -> dict:
         rec["decode_weight_dtype"] = args.decode_weight_dtype
     if args.trace_requests:
         rec["trace_requests"] = True
-    if telemetry is not None:
+    if telemetry is not None and telemetry.port is not None:
         rec["metrics_port"] = telemetry.port
+    if telemetry is not None:
         rec["telemetry_snapshots"] = telemetry.snapshots
+    if controller is not None:
+        rec["control"] = args.control
+        rec["controller"] = controller.summary()
+    if advisor is not None:
+        rec["tuning"] = advisor.summary()
     if flight is not None:
         rec["flight_dumps"] = list(flight.dumps)
         for d in flight.dumps:

@@ -555,6 +555,9 @@ class SpeculativeEngine(PagedEngine):
         _publish_hbm_plane(
             self, pool_bytes=used * self._page_bytes_each
             + self.dpool.pages_in_use * self._drafter_page_bytes_each)
+        if self.controller is not None:
+            # same safe point as the plain paged decode tick (ISSUE 16)
+            self._control_tick()
         for slot, req in list(self._slot_req.items()):
             na = int(n_acc[slot])
             n_att = min(k, int(qlen[slot]) - 1)

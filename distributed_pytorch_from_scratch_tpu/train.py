@@ -301,6 +301,18 @@ def get_train_args(argv=None) -> argparse.Namespace:
                    help="--profile_every: total on-disk capture budget; "
                         "once exhausted, sampling stops BETWEEN windows "
                         "(never mid-window) with a logged skip counter")
+    g.add_argument("--control", choices=["off", "advise", "act"],
+                   default="off",
+                   help="the obs v5 control plane (obs/control.py): the "
+                        "drift advisor consumes each duty-cycled "
+                        "measured-vs-analytic reconcile and the live HBM "
+                        "watermarks and lands versioned tuning_decision "
+                        "ledger events. 'advise' records without acting; "
+                        "'act' applies at safe points — the training "
+                        "knob (dp bucket MiB) is init-boundary, so its "
+                        "decisions land applied=false and take effect at "
+                        "the next launch. 'off' (default) is zero-cost: "
+                        "no advisor, no events, no record fields")
 
     g = p.add_argument_group("other")
     g.add_argument("--random_seed", type=int, default=0)
@@ -351,6 +363,10 @@ def get_train_args(argv=None) -> argparse.Namespace:
         if args.profile_budget_mb <= 0:
             p.error(f"--profile_budget_mb must be > 0, got "
                     f"{args.profile_budget_mb}")
+    if args.control != "off" and not args.profile_every:
+        p.error("--control feeds on the duty profiler's measured "
+                "reconciles (drift is what drives retuning); add "
+                "--profile_every N")
     return args
 
 
@@ -472,6 +488,7 @@ def train(args: argparse.Namespace) -> dict:
         process_index=proc_idx, flight_ring=args.flight_ring,
         profile_on_anomaly=args.profile_on_anomaly)
     duty = None  # DutyCycleProfiler, built once the model shape is known
+    advisor = None  # RetuneAdvisor (obs v5), rides the duty profiler
 
     try:
         dataloader = get_dataloader(args.data_path, args.batch_size,
@@ -740,6 +757,26 @@ def train(args: argparse.Namespace) -> dict:
             duty = DutyCycleProfiler(
                 logs_dir, args.profile_every, args.profile_window,
                 args.profile_budget_mb, writer=writer, analytic=analytic)
+            if args.control != "off":
+                # drift-driven retuning (ISSUE 16): every parsed capture's
+                # reconcile feeds the advisor BETWEEN windows — the hook
+                # below is the registered safe point. dp bucket MiB is
+                # baked into the compiled step, so it is an init-boundary
+                # knob (no setter): act-mode decisions are recorded and
+                # land at the next launch
+                from .obs.control import RetuneAdvisor, control_safe_point
+                advisor = RetuneAdvisor(args.control, writer=writer,
+                                        telemetry=telemetry)
+                advisor.register_knob(
+                    "dp_bucket_mb", lambda: args.dp_reduce_bucket_mb,
+                    integer=False)
+
+                @control_safe_point
+                def _on_attribution(fields):
+                    advisor.observe_attribution(fields)
+                    advisor.apply_decisions()
+
+                duty.on_attribution = _on_attribution
         flops_step = model_flops_per_step(
             cfg, args.batch_size, maxlen,
             params=params if args.family == "gpt2" else None)
@@ -1052,8 +1089,15 @@ def train(args: argparse.Namespace) -> dict:
                         # live HBM watermarks (ISSUE 15): per-device
                         # gauges + one hbm_watermark event per interval
                         # ('unavailable' exported loudly on CPU)
-                        publish_hbm(telemetry=telemetry, writer=writer,
-                                    step=n, event=True)
+                        marks = publish_hbm(telemetry=telemetry,
+                                            writer=writer, step=n,
+                                            event=True)
+                        if advisor is not None:
+                            # proposals only — actuation stays at the
+                            # on_attribution safe point (or close())
+                            advisor.observe_hbm(
+                                {"devices": marks or [],
+                                 "available": marks is not None})
                         if gnorm is not None:
                             writer.scalar("train/grad_norm", gnorm, n)
                         if telemetry is not None:
@@ -1120,6 +1164,15 @@ def train(args: argparse.Namespace) -> dict:
                           + (f", {duty.windows_skipped} window(s) skipped "
                              f"after budget exhaustion"
                              if duty.windows_skipped else "") + ")")
+            # advisor after the duty profiler (whose close() can hand it
+            # one last reconcile), before the writer its ledger lands in
+            if advisor is not None:
+                advisor.close()
+                s = advisor.summary()
+                if s["decisions"]:
+                    print(f"control[{s['mode']}]: {s['decisions']} "
+                          f"decision(s), {s['applied']} applied, last "
+                          f"knob {s['last_knob']}")
             observer.close(print_summary=is_main)
             # exporter after the observer (its final snapshot is the
             # run's last registry state), before the writer it mirrors to
@@ -1135,7 +1188,10 @@ def train(args: argparse.Namespace) -> dict:
                   f"data ({host_dispatches} dispatches; collate+stack ran on "
                   f"the prefetch thread)")
         print(f"training finished at step {n}, avg loss {final_avg:.4f}")
-        return {"steps": n, "avg_loss": final_avg}
+        out = {"steps": n, "avg_loss": final_avg}
+        if advisor is not None:  # zero-cost off: no field when off
+            out["control"] = advisor.summary()
+        return out
     except BaseException:
         # Exceptions BEFORE the loop's own try/finally (bad data path,
         # validation SystemExits, model-init failures) must not leak the
@@ -1144,6 +1200,8 @@ def train(args: argparse.Namespace) -> dict:
         # idempotent, so the happy path's finally running first is fine.
         if duty is not None:
             duty.close()
+        if advisor is not None:
+            advisor.close()
         observer.close(print_summary=False)
         if telemetry is not None:
             telemetry.close()

@@ -298,7 +298,8 @@ class DutyCycleProfiler:
     attribution must not average in."""
 
     def __init__(self, log_dir: str, every: int, window: int = 4,
-                 budget_mb: float = 64.0, writer=None, analytic=None):
+                 budget_mb: float = 64.0, writer=None, analytic=None,
+                 on_attribution=None):
         if every < 1:
             raise ValueError(f"profile_every must be >= 1, got {every}")
         if not 1 <= window <= every:
@@ -320,6 +321,11 @@ class DutyCycleProfiler:
         self.budget_bytes = int(budget_mb * 2**20)
         self.writer = writer
         self.analytic = analytic     # profparse.analytic_phase_report(...)
+        # ISSUE 16: called with each parsed capture's event fields right
+        # after the window FINISHES — i.e. between capture windows, the
+        # documented control-plane safe point (obs/control.RetuneAdvisor
+        # hooks here; never mid-window, never inside a traced function)
+        self.on_attribution = on_attribution
         self._ticks = 0
         self._trace: Optional[ProfilerTrace] = None
         self._started_tick = 0
@@ -383,9 +389,12 @@ class DutyCycleProfiler:
                   f"{self.budget_bytes / 2**20:.1f} MiB) — sampling "
                   f"stops; skipped windows are counted in the summary",
                   file=sys.stderr)
-        if emit_profile_attribution(self.writer, trace.log_dir, "duty",
-                                    steps, self.analytic) is not None:
+        fields = emit_profile_attribution(self.writer, trace.log_dir,
+                                          "duty", steps, self.analytic)
+        if fields is not None:
             self.attributions += 1
+            if self.on_attribution is not None:
+                self.on_attribution(fields)
 
     def close(self, sync=None) -> None:
         """Finish an open window at run end (shorter than requested beats

@@ -183,13 +183,98 @@ def parse_args(argv=None):
     p.add_argument("--baseline", nargs="*", default=None,
                    help="baseline record file(s); default: the committed "
                         "BENCH_r*.json trajectory at the repo root")
+    p.add_argument("--controller", action="store_true",
+                   help="the obs v5 CONTINUOUS gate: instead of comparing "
+                        "against the committed trajectory, gate one "
+                        "record's post-decision window against its "
+                        "pre-decision window (serve.py --control act "
+                        "lands them under rec['controller']['windows']). "
+                        "Post must not be worse: tokens/s within "
+                        "--tol_pct below pre, p95 latencies within "
+                        "--tol_latency_pct above pre. A record with no "
+                        "controller, no decisions, or no APPLIED decision "
+                        "skips visibly (exit 0)")
     p.add_argument("--tol_pct", type=float, default=10.0,
                    help="throughput tolerance band (%% below baseline "
                         "that still passes)")
     p.add_argument("--tol_latency_pct", type=float, default=25.0,
                    help="latency / exposed-comm tolerance band (%% above "
                         "baseline that still passes)")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.controller and args.baseline is not None:
+        p.error("--controller gates one record's pre/post windows; "
+                "--baseline has no meaning there")
+    return args
+
+
+def run_controller(args) -> int:
+    """Post- vs pre-decision windows of ONE --control act record: the
+    controller must not have made the run worse. Skips (visibly, exit 0)
+    when there is nothing to gate — gating absence as failure would
+    punish runs whose traffic never needed a decision."""
+    fresh = load_record(args.fresh)
+    out = {"gate": "controller_window", "fresh": args.fresh}
+
+    def skip(reason):
+        out.update(status="skip", reason=reason)
+        print(json.dumps(out))
+        print(f"gate: SKIP — {reason}", file=sys.stderr)
+        return 0
+
+    ctl = fresh.get("controller")
+    if not isinstance(ctl, dict):
+        return skip("record carries no controller summary (--control off "
+                    "or a pre-v5 record)")
+    if not ctl.get("decisions"):
+        return skip("controller made no decisions (traffic never "
+                    "triggered a rule)")
+    w = ctl.get("windows")
+    if not isinstance(w, dict):
+        return skip("no decision was APPLIED (advise mode, or act with "
+                    "no safe point reached) — no post window exists")
+    pre, post = w.get("pre") or {}, w.get("post") or {}
+    if not pre.get("completed") or not post.get("completed"):
+        return skip("a window has zero completed requests — too little "
+                    "traffic on one side of the first actuation")
+    fields = [("tokens_per_sec", "up", args.tol_pct),
+              ("ttft_ms_p95", "down", args.tol_latency_pct),
+              ("tpot_ms_p95", "down", args.tol_latency_pct)]
+    checks, skipped = [], []
+    for field, direction, tol in fields:
+        pv, qv = pre.get(field), post.get(field)
+        if not isinstance(pv, (int, float)) \
+                or not isinstance(qv, (int, float)) or pv == 0:
+            skipped.append(field)
+            continue
+        if direction == "up":
+            ok = qv >= pv * (1.0 - tol / 100.0)
+        else:
+            ok = qv <= pv * (1.0 + tol / 100.0)
+        checks.append({"field": field, "pre": pv, "post": qv,
+                       "direction": direction, "tol_pct": tol, "ok": ok})
+    regressions = [c for c in checks if not c["ok"]]
+    out.update(status="regression" if regressions else "ok",
+               decisions=ctl.get("decisions"),
+               applied=ctl.get("applied"), checks=checks,
+               skipped_fields=skipped)
+    print(json.dumps(out))
+    for c in checks:
+        arrow = {"up": ">=", "down": "<="}[c["direction"]]
+        verdict = "ok" if c["ok"] else "REGRESSION"
+        print(f"gate: {c['field']}: post {c['post']} {arrow} pre "
+              f"{c['pre']} (tol {c['tol_pct']:g}%) — {verdict}",
+              file=sys.stderr)
+    if skipped:
+        print(f"gate: skipped (absent/zero in a window): "
+              f"{', '.join(skipped)}", file=sys.stderr)
+    if regressions:
+        print(f"gate: FAIL — the controller's decisions made "
+              f"{len(regressions)} metric(s) worse than the pre-decision "
+              f"window", file=sys.stderr)
+        return 1
+    print(f"gate: PASS — post-decision window holds "
+          f"({ctl.get('applied')} applied decision(s))", file=sys.stderr)
+    return 0
 
 
 def run(args) -> int:
@@ -247,7 +332,10 @@ def run(args) -> int:
 
 
 def main(argv=None) -> int:
-    return run(parse_args(argv))
+    args = parse_args(argv)
+    if args.controller:
+        return run_controller(args)
+    return run(args)
 
 
 if __name__ == "__main__":

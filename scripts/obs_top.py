@@ -85,13 +85,26 @@ def render(collector, rollup: dict) -> str:
             line += (f"; {hbm['procs_unavailable']} proc(s) report NO "
                      f"memory stats (not zero — unavailable)")
         lines.append(line)
-    lines.append("| source | tok/s | live | queue | pages | hbm | slo |")
-    lines.append("|---|---|---|---|---|---|---|")
+    ctl = rollup.get("control")
+    if ctl:
+        cp = ctl["procs"]
+        line = (f"control: {cp['act']} act / {cp['advise']} advise / "
+                f"{cp['off']} off, {ctl['decisions']} decision(s)")
+        last = ctl.get("last")
+        if last:
+            line += (f"; last {last['knob']} {last['old']} -> "
+                     f"{last['new']} ({last['mode']}"
+                     + ("" if last.get("applied") else ", not applied")
+                     + ")")
+        lines.append(line)
+    lines.append("| source | tok/s | live | queue | pages | hbm | ctl "
+                 "| slo |")
+    lines.append("|---|---|---|---|---|---|---|---|")
     for key, state in sorted(collector.procs.items()):
         snap = state.get("telemetry_snapshot")
         if snap is None:
             lines.append(f"| {os.path.basename(key)} | (no snapshot yet; "
-                         f"post-hoc events only) | | | | | |")
+                         f"post-hoc events only) | | | | | | |")
             continue
         g = snap.get("gauges", {})
         tps = g.get("serve/tokens_per_sec",
@@ -109,12 +122,26 @@ def render(collector, rollup: dict) -> str:
         else:
             hbm_col = (f"{g.get('hbm/bytes_in_use', 0) / 2**30:.2f}"
                        f"/{g.get('hbm/peak_bytes', 0) / 2**30:.2f}G")
+        # ctl column: mode + decision count + the proc's last moved knob
+        # (folded from its freshest ledger event); off procs render '-'
+        m = g.get("ctl/mode")
+        if m is None:
+            ctl_col = "-"
+        else:
+            ctl_col = ("off", "advise", "act")[int(m)] \
+                if 0 <= int(m) < 3 else "?"
+            ctl_col += f":{g.get('ctl/decisions', 0):.0f}"
+            d = (state.get("controller_decision")
+                 or state.get("tuning_decision"))
+            if d is not None and d.get("knob"):
+                ctl_col += f" {d['knob']}"
         lines.append(
             f"| {os.path.basename(key)} | {tps:.0f} "
             f"| {g.get('serve/live', g.get('train/step', 0)):.0f} "
             f"| {g.get('serve/queue_depth', 0):.0f} "
             f"| {g.get('serve/pages_in_use', 0):.0f}"
-            f"/{g.get('serve/num_pages', 0):.0f} | {hbm_col} | {slo} |")
+            f"/{g.get('serve/num_pages', 0):.0f} | {hbm_col} | {ctl_col} "
+            f"| {slo} |")
     tails = sum(t.records for t in collector._tailers.values())
     invalid = sum(t.invalid for t in collector._tailers.values())
     lines.append(f"({tails} records folded"

@@ -351,6 +351,96 @@ def measured_lines(rdir):
     return rows
 
 
+def _ctl_evidence_bits(ev):
+    """Compress a decision's evidence dict into readable fragments."""
+    bits = []
+    att = ev.get("attainment")
+    if att:
+        bits.append("attainment " + ", ".join(
+            f"{cls} {100 * d.get('attained', 0):.0f}% of "
+            f"{d.get('completed')}" for cls, d in sorted(att.items())))
+    if "queue_depth" in ev:
+        bits.append(f"queue {ev['queue_depth']} vs {ev.get('live')} live")
+    if "phases" in ev:
+        bits.append("comm drift " + ", ".join(
+            f"{k} +{d.get('drift_pct', 0):.0f}%"
+            for k, d in sorted(ev["phases"].items())))
+    if "drift_pct" in ev:
+        bits.append(f"compute drift +{ev['drift_pct']:.0f}%")
+    if "copy_ms" in ev:
+        bits.append(f"copy {ev['copy_ms']}ms of {ev.get('step_ms')}ms "
+                    f"step")
+    if "host_gap_ms" in ev:
+        bits.append(f"host gap {ev['host_gap_ms']}ms of "
+                    f"{ev.get('step_ms')}ms step")
+    if "hbm_headroom_frac" in ev:
+        bits.append(f"HBM headroom {100 * ev['hbm_headroom_frac']:.1f}%")
+    if "acceptance_rate" in ev:
+        bits.append(f"acceptance {ev['acceptance_rate']}")
+    if ev.get("capture"):
+        bits.append(f"capture `{os.path.basename(str(ev['capture']))}`")
+    return bits
+
+
+def control_lines(rdir):
+    """The decision ledger (obs v5): every `tuning_decision` /
+    `controller_decision` event rendered as trigger -> evidence ->
+    action -> measured effect. The effect column joins the decision's
+    `snapshot_seq` cross-link to the NEXT telemetry snapshot in the same
+    stream — the registry state one window later, measured, not
+    asserted."""
+    decs_by_file, snaps_by_file = {}, {}
+    for rel, rec in _iter_events(
+            rdir, ("tuning_decision", "controller_decision",
+                   "telemetry_snapshot")):
+        if rec.get("tag") == "telemetry_snapshot":
+            snaps_by_file.setdefault(rel, []).append(rec)
+        else:
+            decs_by_file.setdefault(rel, []).append(rec)
+    rows = []
+    for rel, decs in sorted(decs_by_file.items()):
+        snaps = snaps_by_file.get(rel, [])
+        for d in decs:
+            ev = d.get("evidence") or {}
+            trigger = d.get("trigger") or ev.get("trigger") or "?"
+            action = f"{d.get('knob')} {d.get('old')} -> {d.get('new')}"
+            if d.get("applied"):
+                action += " (applied)"
+            else:
+                why = d.get("note") or d.get("error")
+                action += (f" (NOT applied: {why})" if why
+                           else " (not applied — "
+                                f"{d.get('mode')} mode)")
+            bits = _ctl_evidence_bits(ev)
+            seq = d.get("snapshot_seq")
+            if seq:
+                bits.append(f"snapshot #{seq}")
+            # measured effect: the decision-time snapshot (seq, 1-based)
+            # vs the next one in stream order — one window later
+            effect = None
+            if seq and 0 < seq <= len(snaps):
+                g0 = snaps[seq - 1].get("gauges", {})
+                nxt = snaps[seq] if seq < len(snaps) else None
+                if nxt is not None:
+                    g1 = nxt.get("gauges", {})
+                    effect = (f"tok/s "
+                              f"{g0.get('serve/tokens_per_sec', 0):.0f} "
+                              f"-> "
+                              f"{g1.get('serve/tokens_per_sec', 0):.0f}, "
+                              f"queue "
+                              f"{g0.get('serve/queue_depth', 0):.0f} -> "
+                              f"{g1.get('serve/queue_depth', 0):.0f} "
+                              f"(snapshot #{seq} -> #{seq + 1})")
+                else:
+                    effect = "run ended before the next snapshot"
+            rows.append(f"- `{rel}` [{d.get('tag')} seq "
+                        f"{d.get('seq', '?')}] {trigger} "
+                        f"({'; '.join(bits) or 'no evidence fields'}) "
+                        f"=> {action}"
+                        + (f" => effect: {effect}" if effect else ""))
+    return rows
+
+
 def hbm_lines(rdir):
     """Peak-HBM watermarks from `hbm_watermark` events (ISSUE 15): the
     last event per metrics file — and a LOUD 'unavailable' line for
@@ -611,6 +701,12 @@ def summarize(rdir):
         out.append("Measured vs analytic (obs v4: parsed jax.profiler "
                    "captures, profile_attribution events):")
         out.extend(measured)
+    ctl = control_lines(rdir)
+    if ctl:
+        out.append("")
+        out.append("Control plane (obs v5: the decision ledger — trigger "
+                   "-> evidence -> action -> measured effect):")
+        out.extend(ctl)
     hbm = hbm_lines(rdir)
     if hbm:
         out.append("")

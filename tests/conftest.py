@@ -223,6 +223,13 @@ CORE_LANE = {
         "test_rank_skew_ranks_stragglers",
         "test_serve_dry_run_with_tracing_and_flight",
     ],
+    # obs v5 (ISSUE 16): the control plane — the committed-reconcile
+    # pinned decision, the advise/act ladder laws (advise never mutates,
+    # act only at safe points), the loadgen-replay adaptation + ledger
+    # reconstruction end-to-end, the zero-cost off pin, the schema-v5
+    # ledger contracts, and the --controller window gate (whole file:
+    # one tiny dry serve + one tiny replay serve, ~8 s)
+    "test_control.py": None,
 }
 
 

@@ -1,0 +1,11 @@
+"""BAD: actuating the controller from an arbitrary call site — this
+drain loop fires apply_decisions mid-batch, so a knob (prefill chunk,
+pages_per_block, speculation K) moves inside the very window the
+decision's evidence was measured over, tearing the attribution."""
+
+
+def drain_requests(engine):
+    for req in engine.pending():
+        engine.step(req)
+        engine.controller.apply_decisions()    # mid-window actuation!
+    return engine.stats()

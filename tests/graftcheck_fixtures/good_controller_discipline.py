@@ -1,0 +1,20 @@
+"""GOOD: actuation only happens inside a registered safe point — the
+`@control_safe_point` function runs on the host between capture
+windows, so knobs move where no measurement is in flight and the next
+window sees one consistent config."""
+
+from distributed_pytorch_from_scratch_tpu.obs.control import (
+    control_safe_point)
+
+
+def drain_requests(engine):
+    for req in engine.pending():
+        engine.step(req)
+    control_tick(engine.controller)            # the safe point, post-batch
+    return engine.stats()
+
+
+@control_safe_point
+def control_tick(controller):
+    controller.tick(0)
+    controller.apply_decisions()

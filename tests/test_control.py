@@ -1,0 +1,448 @@
+"""The control plane (obs v5, ISSUE 16): drift-driven retuning, the
+online SLO controller, and the auditable decision ledger.
+
+Laws pinned here, all on CPU:
+
+* a committed reconcile fixture produces a PINNED RetuneAdvisor decision
+  (the rules are a contract, not a heuristic that may drift);
+* the --control ladder: `advise` computes + ledgers but NEVER mutates;
+  `act` mutates ONLY inside `apply_decisions()` called from a
+  `@control_safe_point` function (the graftcheck rule's dynamic twin);
+* the SLO controller demonstrably adapts under a loadgen traffic shift,
+  every actuation cross-links its triggering telemetry snapshot, and
+  the ledger alone reconstructs the knob trajectory;
+* the off state is ZERO-cost: no events, no record fields — a
+  `--control off` run is byte-shaped like a pre-v5 run;
+* schema v5: both ledger event tags validate, and their required-field
+  contracts cannot drift silently.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+
+import pytest
+
+from distributed_pytorch_from_scratch_tpu.obs.control import (
+    CONTROL_MODES, RetuneAdvisor, control_safe_point)
+from distributed_pytorch_from_scratch_tpu.obs.schema import (
+    EVENT_REQUIRED, EVENT_SCHEMA_VERSION, validate_record)
+from distributed_pytorch_from_scratch_tpu.obs.telemetry import (
+    TelemetryExporter)
+from distributed_pytorch_from_scratch_tpu.serving.controller import (
+    SLOController)
+from distributed_pytorch_from_scratch_tpu.training.metrics import (
+    MetricsWriter)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIXTURE = os.path.join(HERE, "data", "reconcile_drift.json")
+
+
+def _load_fixture():
+    with open(FIXTURE) as f:
+        return json.load(f)
+
+
+@control_safe_point
+def _actuate(ctl):
+    """The tests' one registered safe point (controller-discipline: an
+    undecorated apply_decisions() call would fail the repo sweep)."""
+    return ctl.apply_decisions()
+
+
+def _events(log_dir, *tags):
+    out = []
+    for p in sorted(glob.glob(os.path.join(log_dir, "**",
+                                           "metrics*.jsonl"),
+                              recursive=True)):
+        for line in open(p):
+            rec = json.loads(line)
+            if not tags or rec.get("tag") in tags:
+                out.append(rec)
+    return out
+
+
+def _load_script(name):
+    path = os.path.join(REPO, "scripts", name + ".py")
+    spec = importlib.util.spec_from_file_location(f"_ctl_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# --------------------------------------------- the RetuneAdvisor rules --
+
+def test_reconcile_fixture_pins_retune_decision(tmp_path):
+    """The committed drift fixture (66.7% all-reduce drift, copy and
+    host_gap both under their thresholds, compute on-model) produces
+    EXACTLY one decision: dp_bucket_mb 0 -> 4.0 (unbucketed -> seeded),
+    evidenced by the capture id and the drifted phase — and the ledger
+    event validates under schema v5."""
+    with MetricsWriter(str(tmp_path), process_index=0) as w:
+        adv = RetuneAdvisor("advise", writer=w)
+        adv.register_knob("dp_bucket_mb", lambda: 0, integer=False)
+        # knobs the fixture must NOT move (their signals are sub-threshold)
+        state = {"pages": 1, "chunk": 32}
+        adv.register_knob("pages_per_block", lambda: state["pages"], lo=1)
+        adv.register_knob("prefill_chunk", lambda: state["chunk"], lo=1)
+        out = adv.observe_attribution(_load_fixture())
+    assert len(out) == 1 and adv.decisions == out
+    d = out[0]
+    assert d["knob"] == "dp_bucket_mb"
+    assert d["old"] == 0 and d["new"] == 4.0
+    assert d["applied"] is False and d["mode"] == "advise"
+    assert d["evidence"]["trigger"] == "comm_drift"
+    assert d["evidence"]["capture"] == "/tmp/profiles/duty_000123"
+    assert d["evidence"]["phases"]["all-reduce"]["drift_pct"] == 66.7
+    events = _events(str(tmp_path), "tuning_decision")
+    assert len(events) == 1
+    assert validate_record(events[0]) == []
+    # a REPEATED identical signal must not spam the ledger
+    with MetricsWriter(str(tmp_path / "again"), process_index=0) as w:
+        adv2 = RetuneAdvisor("advise", writer=w)
+        adv2.register_knob("dp_bucket_mb", lambda: 0, integer=False)
+        assert len(adv2.observe_attribution(_load_fixture())) == 1
+        assert adv2.observe_attribution(_load_fixture()) == []
+
+
+def test_advise_never_mutates(tmp_path):
+    """The advise rung: decisions land in the ledger applied=false and
+    no setter ever runs — even through an explicit safe point."""
+    fields = {"capture": "c1", "reconcile": {
+        "measured_step_ms": 100.0, "rows": [
+            {"phase": "host_gap", "measured_ms": 30.0,
+             "analytic_ms": None, "drift_pct": None}]}}
+    state = {"chunk": 16}
+
+    def setter(v):                        # must never fire in advise
+        raise AssertionError("advise mutated a knob")
+
+    with MetricsWriter(str(tmp_path), process_index=0) as w:
+        adv = RetuneAdvisor("advise", writer=w)
+        adv.register_knob("prefill_chunk", lambda: state["chunk"], setter,
+                          lo=1)
+        out = adv.observe_attribution(fields)
+        assert [d["knob"] for d in out] == ["prefill_chunk"]
+        assert out[0]["applied"] is False
+        assert _actuate(adv) == 0         # nothing queued in advise
+        adv.close()
+    assert state["chunk"] == 16
+    events = _events(str(tmp_path), "tuning_decision")
+    assert [e["applied"] for e in events] == [False]
+
+
+def test_act_applies_only_at_safe_points(tmp_path):
+    """The act rung: a proposal QUEUES (no mutation, no ledger event) at
+    observation time and lands only when apply_decisions() runs from a
+    @control_safe_point function; an init-boundary knob (no setter) and
+    a refusing setter both ledger applied=false with the reason; close()
+    flushes anything that never reached a safe point."""
+    assert getattr(_actuate, "__control_safe_point__", False) is True
+    fields = {"capture": "c2", "reconcile": {
+        "measured_step_ms": 100.0, "rows": [
+            {"phase": "all-reduce", "measured_ms": 40.0,
+             "analytic_ms": 20.0, "drift_pct": 100.0},
+            {"phase": "host_gap", "measured_ms": 30.0,
+             "analytic_ms": None, "drift_pct": None},
+            {"phase": "copy", "measured_ms": 20.0, "analytic_ms": 10.0,
+             "drift_pct": 100.0}]}}
+    state = {"chunk": 16}
+
+    def refuse(v):
+        raise ValueError("online config would shadow a sweep result")
+
+    with MetricsWriter(str(tmp_path), process_index=0) as w:
+        adv = RetuneAdvisor("act", writer=w)
+        adv.register_knob("dp_bucket_mb", lambda: 0, integer=False)
+        adv.register_knob("prefill_chunk", lambda: state["chunk"],
+                          lambda v: state.__setitem__("chunk", int(v)),
+                          lo=1)
+        adv.register_knob("pages_per_block", lambda: 1, refuse, lo=1)
+        out = adv.observe_attribution(fields)
+        assert len(out) == 3
+        # proposed but NOT actuated, NOT yet ledgered
+        assert state["chunk"] == 16 and adv.decisions == []
+        assert _events(str(tmp_path), "tuning_decision") == []
+        assert _actuate(adv) == 1         # only prefill_chunk could move
+        assert state["chunk"] == 32
+        by_knob = {d["knob"]: d for d in adv.decisions}
+        assert by_knob["prefill_chunk"]["applied"] is True
+        assert by_knob["dp_bucket_mb"]["applied"] is False
+        assert "init-boundary" in by_knob["dp_bucket_mb"]["note"]
+        assert by_knob["pages_per_block"]["applied"] is False
+        assert "shadow" in by_knob["pages_per_block"]["error"]
+        # a queued proposal that never reaches a safe point still ledgers
+        adv.observe_hbm({"available": True, "devices": [
+            {"bytes_in_use": 95, "limit_bytes": 100}]})
+        adv.close()
+    flushed = [e for e in _events(str(tmp_path), "tuning_decision")
+               if e.get("note", "").startswith("unapplied")]
+    assert flushed and all(e["applied"] is False for e in flushed)
+    # and the static rule agrees: an undecorated call site violates
+    from distributed_pytorch_from_scratch_tpu.analysis.rules import (
+        lint_file)
+    vios = lint_file("snippet.py",
+                     text="def f(c):\n    c.apply_decisions()\n")
+    assert any(v.rule == "controller-discipline" for v in vios)
+    vios = lint_file("snippet.py", text=(
+        "from distributed_pytorch_from_scratch_tpu.obs.control import "
+        "control_safe_point\n"
+        "@control_safe_point\n"
+        "def f(c):\n    c.apply_decisions()\n"))
+    assert not any(v.rule == "controller-discipline" for v in vios)
+
+
+# ------------------------------------------------- the SLO controller --
+
+class _FakeReq:
+    def __init__(self, ttft_s, finish_t, slo_class="interactive",
+                 ntok=8, tpot_s=0.01):
+        self.slo_class = slo_class
+        self.ttft_s = ttft_s
+        self.tpot_s = tpot_s
+        self.finish_t = finish_t
+        self.tokens = [0] * ntok
+
+
+class _FakeSched:
+    def __init__(self, classes, max_queue):
+        self.classes = classes
+        self.max_queue = max_queue
+        self.pending = 0
+
+
+class _FakeEngine:
+    def __init__(self, max_queue=16):
+        self.scheduler = _FakeSched({"interactive": 0.05}, max_queue)
+        self.completed = []
+        self._slot_req = {}
+        self.prefill_chunk = 32
+
+    def stats(self):
+        return {}
+
+
+def test_slo_controller_adapts_and_ledger_reconstructs(tmp_path):
+    """A traffic shift (SLO collapse with a deep queue, then recovery)
+    drives the controller: clamp admission, then restore — each
+    actuation cross-linked (snapshot_seq) to a telemetry snapshot that
+    is IN the stream, and the ledger events alone reconstruct the knob
+    trajectory from init to final value."""
+    t = {"now": 0.0}
+    eng = _FakeEngine(max_queue=16)
+    with MetricsWriter(str(tmp_path), process_index=0) as w:
+        tele = TelemetryExporter(writer=w)   # headless: registry only
+        ctl = SLOController(eng, "act", writer=w, telemetry=tele,
+                            interval=8, cooldown=1,
+                            clock=lambda: t["now"])
+        # window 1: every interactive TTFT misses 50ms, queue is deep
+        t["now"] = 1.0
+        eng.completed += [_FakeReq(0.2, finish_t=0.5 + 0.05 * i)
+                          for i in range(6)]
+        eng.scheduler.pending, eng._slot_req = 20, {0: 1, 1: 1}
+        ctl.tick(8)
+        assert eng.scheduler.max_queue == 16        # queued, not acted
+        assert ctl.decisions == []
+        assert _actuate(ctl) == 1
+        assert eng.scheduler.max_queue == 8
+        d1 = ctl.decisions[0]
+        assert (d1["knob"], d1["trigger"]) == ("max_queue",
+                                               "slo_miss_queue")
+        assert d1["applied"] is True and d1["snapshot_seq"] == 1
+        # window 2: attainment recovers -> the clamp relaxes to init
+        t["now"] = 2.0
+        eng.completed += [_FakeReq(0.01, finish_t=1.5 + 0.05 * i)
+                          for i in range(6)]
+        eng.scheduler.pending = 2
+        ctl.tick(16)
+        _actuate(ctl)
+        assert eng.scheduler.max_queue == 16
+        d2 = ctl.decisions[1]
+        assert (d2["knob"], d2["trigger"]) == ("max_queue", "recovered")
+        assert d2["snapshot_seq"] == 2
+        # pre/post windows split at the FIRST actuation
+        wds = ctl.windows()
+        assert wds["pre"]["completed"] == 6
+        assert wds["post"]["completed"] == 6
+        assert ctl.summary()["windows"] == wds
+        ctl.close()
+    # the ledger reconstructs the trajectory: old chains to new, and the
+    # cross-linked snapshots exist in the stream BEFORE their decisions
+    stream = _events(str(tmp_path), "controller_decision",
+                     "telemetry_snapshot")
+    value, snaps_seen = 16, 0
+    for rec in stream:
+        if rec["tag"] == "telemetry_snapshot":
+            snaps_seen += 1
+            continue
+        assert validate_record(rec) == []
+        assert rec["snapshot_seq"] <= snaps_seen
+        if rec["applied"]:
+            assert rec["old"] == value
+            value = rec["new"]
+    assert value == eng.scheduler.max_queue == 16
+
+
+def test_loadgen_replay_traffic_shift_end_to_end(tmp_path, capsys):
+    """The acceptance path: serve.py --control act over a REPLAYED trace
+    whose traffic shifts mid-run (4 easy arrivals, then a 20-request
+    flood against an impossible interactive deadline) must produce >= 1
+    applied controller_decision cross-linked to its telemetry snapshot,
+    carry the pre/post windows in the record, and render in
+    summarize_run's control-plane timeline."""
+    from distributed_pytorch_from_scratch_tpu.serving import (
+        serve as serve_mod)
+
+    rng_ids = [3, 5, 7, 9, 11, 13]
+    trace = str(tmp_path / "trace.jsonl")
+    with open(trace, "w") as f:
+        for i in range(24):
+            f.write(json.dumps({
+                "rid": i, "prompt": [rng_ids[(i + j) % 6]
+                                     for j in range(6)],
+                "max_new": 8, "seed": i,
+                "arrival": 0.0 if i < 4 else 0.3}) + "\n")
+    log_dir = str(tmp_path / "logs")
+    serve_mod.main([
+        "--random_init", "--paged", "--no-bf16",
+        "--attn_dim", "32", "--ffn_dim", "64", "--num_heads", "4",
+        "--num_layers", "2", "--maxlen", "64", "--vocab_size", "64",
+        "--slots", "4", "--page_size", "8", "--max_new_tokens", "8",
+        "--arrival", "replay", "--replay", trace,
+        "--slo_classes", "interactive=0.0001",
+        "--default_class", "interactive",
+        "--control", "act", "--control_interval", "16",
+        "--log_dir", log_dir])
+    # the control fields ride the stdout JSON record (the gate's food),
+    # not run_loadgen's summary dict
+    rec = json.loads([l for l in capsys.readouterr().out.splitlines()
+                      if l.startswith("{")][-1])
+    assert rec["control"] == "act"
+    ctl = rec["controller"]
+    assert ctl["mode"] == "act" and ctl["decisions"] >= 1
+    assert ctl["applied"] >= 1
+    assert "windows" in ctl
+    assert ctl["windows"]["pre"]["completed"] >= 1
+    assert ctl["windows"]["post"]["completed"] >= 1
+    # ledger: >= 1 applied decision whose snapshot cross-link resolves
+    stream = _events(log_dir, "controller_decision",
+                     "telemetry_snapshot")
+    snaps_seen, applied = 0, []
+    for r in stream:
+        if r["tag"] == "telemetry_snapshot":
+            snaps_seen += 1
+            continue
+        assert validate_record(r) == []
+        assert 1 <= r["snapshot_seq"] <= snaps_seen
+        if r["applied"]:
+            applied.append(r)
+    assert applied
+    # and the post-hoc timeline renders trigger -> action -> effect
+    sr = _load_script("summarize_run")
+    text = "\n".join(sr.control_lines(str(tmp_path)))
+    assert "controller_decision" in text or applied[0]["knob"] in text
+    assert "=>" in text
+
+
+# ------------------------------------------------- the zero-cost off --
+
+def test_off_state_is_zero_cost(tmp_path):
+    """--control off (the default) must look EXACTLY like a pre-v5 run:
+    no ledger events, no control/controller/tuning record fields, no
+    ctl/* gauges — and the off-mode advisor/controller are inert."""
+    from distributed_pytorch_from_scratch_tpu.serving import (
+        serve as serve_mod)
+
+    log_dir = str(tmp_path / "off_logs")
+    rec = serve_mod.main(["--dry_run", "--paged", "--log_dir", log_dir])
+    for field in ("control", "controller", "tuning",
+                  "telemetry_snapshots", "metrics_port"):
+        assert field not in rec, field
+    assert _events(log_dir, "tuning_decision", "controller_decision",
+                   "telemetry_snapshot") == []
+    # the off-mode objects observe nothing and emit nothing
+    adv = RetuneAdvisor("off")
+    assert adv.observe_attribution(_load_fixture()) == []
+    assert adv.observe_hbm({"available": True, "devices": [
+        {"bytes_in_use": 99, "limit_bytes": 100}]}) == []
+    assert adv.decisions == [] and adv.summary()["decisions"] == 0
+    eng = _FakeEngine()
+    ctl = SLOController(eng, "off", interval=1)
+    eng.completed += [_FakeReq(0.2, finish_t=0.5) for _ in range(8)]
+    eng.scheduler.pending = 50
+    ctl.tick(8)
+    assert _actuate(ctl) == 0 and ctl.decisions == []
+    assert eng.scheduler.max_queue == 16
+
+
+# ----------------------------------------------------- schema v5 pins --
+
+def test_schema_v5_ledger_contracts():
+    """The version and both ledger tags' required fields are pinned —
+    a consumer keyed on snapshot_seq must notice if it ever drifts."""
+    assert EVENT_SCHEMA_VERSION == 5
+    assert CONTROL_MODES == ("off", "advise", "act")
+    assert EVENT_REQUIRED["tuning_decision"] == (
+        "knob", "old", "new", "evidence", "mode", "applied")
+    assert EVENT_REQUIRED["controller_decision"] == (
+        "knob", "old", "new", "trigger", "mode", "applied",
+        "snapshot_seq")
+    ok = {"tag": "controller_decision", "schema_version": 5,
+          "knob": "max_queue", "old": 16, "new": 8,
+          "trigger": "slo_miss_queue", "mode": "act", "applied": True,
+          "snapshot_seq": 1}
+    assert validate_record(ok) == []
+    bad = dict(ok)
+    del bad["snapshot_seq"]
+    assert any("snapshot_seq" in p for p in validate_record(bad))
+    futur = dict(ok, schema_version=EVENT_SCHEMA_VERSION + 1)
+    assert any("NEWER" in p for p in validate_record(futur))
+
+
+# -------------------------------------- the continuous gate (--controller) --
+
+@pytest.mark.parametrize("post,expect_rc", [
+    ({"completed": 6, "tokens_per_sec": 120.0, "ttft_ms_p95": 40.0,
+      "tpot_ms_p95": 9.0}, 0),            # improved -> pass
+    ({"completed": 6, "tokens_per_sec": 60.0, "ttft_ms_p95": 90.0,
+      "tpot_ms_p95": 9.0}, 1),            # degraded -> fail
+])
+def test_controller_gate_pass_and_fail(tmp_path, capsys, post, expect_rc):
+    rec = {"metric": "serve", "controller": {
+        "mode": "act", "decisions": 2, "applied": 1,
+        "windows": {"pre": {"completed": 5, "tokens_per_sec": 100.0,
+                            "ttft_ms_p95": 50.0, "tpot_ms_p95": 10.0},
+                    "post": post}}}
+    path = str(tmp_path / "rec.json")
+    json.dump(rec, open(path, "w"))
+    gate = _load_script("check_bench_regression")
+    rc = gate.main(["--fresh", path, "--controller"])
+    assert rc == expect_rc
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["gate"] == "controller_window"
+    assert out["status"] == ("ok" if expect_rc == 0 else "regression")
+
+
+def test_controller_gate_skips_visibly(tmp_path, capsys):
+    """No controller block / zero decisions / nothing applied: the gate
+    SKIPS (exit 0) with the reason on stderr — absence of a decision is
+    not a regression."""
+    gate = _load_script("check_bench_regression")
+    cases = [
+        ({"metric": "serve"}, "no controller"),
+        ({"metric": "serve",
+          "controller": {"mode": "act", "decisions": 0, "applied": 0}},
+         "no decisions"),
+        ({"metric": "serve",
+          "controller": {"mode": "advise", "decisions": 3, "applied": 0}},
+         "APPLIED"),
+    ]
+    for i, (rec, needle) in enumerate(cases):
+        path = str(tmp_path / f"rec{i}.json")
+        json.dump(rec, open(path, "w"))
+        assert gate.main(["--fresh", path, "--controller"]) == 0
+        cap = capsys.readouterr()
+        assert json.loads(cap.out.strip())["status"] == "skip"
+        assert "SKIP" in cap.err and needle in cap.err

@@ -34,7 +34,10 @@ R5 = os.path.join(REPO, "runs", "r5")
 # gather-vs-pallas A/B sweep with int8 and speculative arms,
 # r16 measured attribution: duty-cycled profiled train window, the
 # measured breakdown + profiled serving bench arms, the anomaly capture
-# that parses, and the measured-ms regression gate)
+# that parses, and the measured-ms regression gate,
+# r17 the control plane: advise-mode train window, act-mode serving
+# loadgen with a burst traffic shift, the off-mode zero-cost arm, and
+# the check_bench_regression --controller window gate)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -45,7 +48,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r13"),
                             os.path.join(REPO, "runs", "r14"),
                             os.path.join(REPO, "runs", "r15"),
-                            os.path.join(REPO, "runs", "r16"))
+                            os.path.join(REPO, "runs", "r16"),
+                            os.path.join(REPO, "runs", "r17"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
