@@ -46,6 +46,7 @@ from distributed_pytorch_from_scratch_tpu.config import (IGNORE_INDEX,
                                                          REMAT_CHOICES,
                                                          OptimizerConfig,
                                                          model_preset)
+from distributed_pytorch_from_scratch_tpu.obs.runindex import run_stamp
 from distributed_pytorch_from_scratch_tpu.training.optim import init_adam_state
 from distributed_pytorch_from_scratch_tpu.training.metrics import (
     ProfilerTrace, allreduce_p50_us, chip_peak_flops, device_memory_gib,
@@ -570,6 +571,7 @@ def run_decode_bench(args, mesh, cfg, tp: int) -> None:
         "probe_steps": probe_steps,
         "kv_rate_per_stream": round(kv_rate_stream, 1),
         "ref_recompute_rate": round(ref_rate, 1),
+        **run_stamp(vars(args)),
     }))
 
 
@@ -1043,6 +1045,7 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             "queue_wait_ms_p95": summary["queue_wait_ms_p95"],
             "slot_occupancy_mean": summary["slot_occupancy_mean"],
         },
+        **run_stamp(vars(args)),
     }))
 
 
@@ -1121,6 +1124,7 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
             "suspects": [{k: (round(v, 3) if isinstance(v, float) else v)
                           for k, v in s.items()}
                          for s in report["suspects"]],
+            **run_stamp(vars(args)),
         }))
         return
 
@@ -1330,10 +1334,11 @@ def run_breakdown(args, mesh, cfg, tp: int) -> None:
                           for k, v in s.items()}
                          for s in report["suspects"]],
         },
+        **run_stamp(vars(args)),
     }))
 
 
-def _discover_backend(probe=None, timeout_s=240.0):
+def _discover_backend(probe=None, timeout_s=240.0, stamp=None):
     """Device count, or ONE machine-readable JSON error line + exit rc=0.
 
     Backend discovery is the only step that has ever voided a BENCH
@@ -1371,13 +1376,15 @@ def _discover_backend(probe=None, timeout_s=240.0):
     th.join(timeout_s)
     if th.is_alive():
         print(json.dumps({"metric": "bench", "error": "backend_unavailable",
-                          "detail": f"backend init hung > {timeout_s:.0f}s"}))
+                          "detail": f"backend init hung > {timeout_s:.0f}s",
+                          **(stamp or {})}))
         sys.stdout.flush()
         sys.stderr.flush()
         os._exit(0)
     if "n" not in result:
         print(json.dumps({"metric": "bench", "error": "backend_unavailable",
-                          "detail": result.get("err", "probe died")}))
+                          "detail": result.get("err", "probe died"),
+                          **(stamp or {})}))
         raise SystemExit(0)
     return result["n"]
 
@@ -1388,7 +1395,10 @@ def main(argv=None):
         timeout_s = float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", "240"))
     except ValueError:
         timeout_s = 240.0
-    n_dev = _discover_backend(timeout_s=timeout_s)
+    # ISSUE 17: even an outage record carries the provenance stamp — a
+    # tunnel drop at a known fingerprint is still forensic evidence
+    n_dev = _discover_backend(timeout_s=timeout_s,
+                              stamp=run_stamp(vars(args)))
     tp = args.tp or max(1, n_dev // args.dp)
     if args.dp_reduce_bucket_mb and tp > 1 and not args.sequence_parallel:
         # fail HERE with the same clean message train.py gives — inside
@@ -1582,6 +1592,7 @@ def main(argv=None):
         # per device — the memory claim is measured, not asserted
         "zero_stage": args.zero,
         "param_bytes_per_device": pbpd[0],
+        **run_stamp(vars(args)),
     }))
 
 

@@ -3,9 +3,10 @@ program introspection, a training-health sentinel, a hang watchdog,
 (v2, ISSUE 10) per-request tracing, an anomaly flight recorder, and
 cross-rank skew attribution, (v3, ISSUE 12) the live telemetry
 plane: per-process exporters, the fleet collector, cross-process trace
-propagation, and anomaly-triggered device profiling, and (v5, ISSUE 16)
+propagation, and anomaly-triggered device profiling, (v5, ISSUE 16)
 the control plane: drift-driven retuning with an auditable decision
-ledger.
+ledger, and (v6, ISSUE 17) run forensics: the run-archive RunCard
+index, the cross-run diff engine, and trajectory changepoint triage.
 
 See docs/OBSERVABILITY.md for the operator's view (trace format, goodput
 buckets, sentinel thresholds, flight-dump walkthrough, live endpoints).
@@ -23,6 +24,11 @@ from .goodput import BUCKETS, GoodputMeter
 from .introspect import analyze_compiled, format_analysis, parse_collectives
 from .observer import TrainObserver
 from .reqtrace import RequestTracer, TraceContext, merge_traces
+from .rundiff import (changepoint, diff_runs, format_diff,
+                      format_trajectory, trajectory_report)
+from .runindex import (card_from_bench_path, card_from_run_dir,
+                       config_fingerprint, format_card, index_repo,
+                       outage_reason, run_stamp)
 from .schema import (EVENT_REQUIRED, EVENT_SCHEMA_VERSION, validate_jsonl,
                      validate_record)
 from .sentinel import HealthSentinel, TrainingHealthError
@@ -36,9 +42,13 @@ __all__ = [
     "HealthSentinel", "JsonlTailer", "Knob", "RequestTracer",
     "RetuneAdvisor", "SpanTracer", "TelemetryExporter", "TraceContext",
     "TrainObserver", "TrainingHealthError", "analytic_phase_report",
-    "analyze_compiled", "attribution", "control_safe_point",
-    "flash_tile_stats", "fleet_slo_attainment", "format_analysis",
-    "format_attribution", "format_reconcile", "merge_traces",
+    "analyze_compiled", "attribution", "card_from_bench_path",
+    "card_from_run_dir", "changepoint", "config_fingerprint",
+    "control_safe_point", "diff_runs", "flash_tile_stats",
+    "fleet_slo_attainment", "format_analysis", "format_attribution",
+    "format_card", "format_diff", "format_reconcile",
+    "format_trajectory", "index_repo", "merge_traces", "outage_reason",
     "parse_capture", "parse_collectives", "rank_skew", "reconcile",
-    "validate_jsonl", "validate_record",
+    "run_stamp", "trajectory_report", "validate_jsonl",
+    "validate_record",
 ]

@@ -1,0 +1,522 @@
+"""Run-archive index: normalize every recorded run into a RunCard
+(ISSUE 17, obs v6).
+
+The repo accumulates runs in three shapes — committed `BENCH_rNN.json` /
+`MULTICHIP_rNN.json` wrappers at the root, `runs/rN/` session dirs full
+of bench arms + metrics jsonl + flight dumps, and raw `bench.py` stdout
+lines — and until now nothing could answer "what runs do we have, which
+are trustworthy, and what config produced each one". This module walks
+all of them and emits one versioned **RunCard** per run: config
+fingerprint, backend, headline metrics, event/anomaly counts,
+controller-decision summary, profile-capture inventory, and an outage
+classification.
+
+Two contracts matter more than the rest:
+
+* `outage_reason` is THE single outage classifier. The bench-regression
+  gate's `pick_baseline` (scripts/check_bench_regression.py) and this
+  index both call it — an rc != 0 / `backend_unavailable` / metric-less
+  record is an *outage* and can never become a baseline, and there is
+  exactly one piece of code that decides that (the r02/r05 records are
+  the pinned fixtures).
+* legacy records (the BENCH_r01–r05 era, before `config_fingerprint`
+  stamping) flow through the same normalization with a loud
+  "legacy record, fingerprint unavailable" note — never a crash, and
+  never a silent `None == None` config match downstream.
+
+Deliberately dependency-free (no jax, no package imports): scripts load
+this file with the obs dir on sys.path, the same standalone contract as
+`schema.py`.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import re
+import subprocess
+from typing import Any, Dict, List, Optional, Tuple
+
+try:  # package import (obs consumers) vs obs-dir-on-sys.path (scripts)
+    from .schema import EVENT_SCHEMA_VERSION
+except ImportError:  # pragma: no cover - exercised via scripts
+    from schema import EVENT_SCHEMA_VERSION
+
+# Bump when a RunCard field a consumer keys on changes incompatibly.
+# Version 1 = the ISSUE-17 card: run/kind/outage/baseline_eligible +
+# fingerprint/metrics/ledger/captures inventory.
+RUN_CARD_VERSION = 1
+
+LEGACY_NOTE = "legacy record, fingerprint unavailable"
+
+# headline fields lifted verbatim from a bench/serving record onto the
+# card (the same fields the regression gate bands); everything else the
+# diff engine needs (measured_vs_analytic, controller) is kept whole.
+HEADLINE_FIELDS = (
+    "metric", "unit", "value", "vs_baseline", "paged_vs_slot",
+    "accepted_tokens_per_dispatch", "ttft_ms_p95", "tpot_ms_p95",
+    "decode_hbm_bytes_per_step", "tokens_per_sec",
+)
+
+_BACKEND_RE = re.compile(r"device\(s\)\s*\[([^\]]+)\]")
+
+
+# ----------------------------------------------------------------- stamping --
+
+def normalize_config(config: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-stable view of an argparse namespace dict: scalars and
+    scalar-lists pass through, anything exotic is stringified — the
+    fingerprint must never depend on repr() ordering or object ids."""
+    out: Dict[str, Any] = {}
+    for key in sorted(config):
+        v = config[key]
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[key] = v
+        elif isinstance(v, (list, tuple)):
+            out[key] = [x if isinstance(x, (str, int, float, bool))
+                        or x is None else str(x) for x in v]
+        else:
+            out[key] = str(v)
+    return out
+
+
+def config_fingerprint(config: Dict[str, Any]) -> str:
+    """12-hex-char sha256 of the normalized config — the join key the
+    diff engine uses to decide 'same knobs' without field-by-field
+    comparison."""
+    blob = json.dumps(normalize_config(config), sort_keys=True,
+                      separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def git_rev(repo: Optional[str] = None) -> Optional[str]:
+    """Short git rev of the producing tree, or None (never raises — a
+    bench run inside a tarball export must still emit its record)."""
+    try:
+        proc = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=repo or os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            capture_output=True, text=True, timeout=10)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    rev = proc.stdout.strip()
+    return rev if proc.returncode == 0 and rev else None
+
+
+def run_stamp(config: Dict[str, Any],
+              repo: Optional[str] = None) -> Dict[str, Any]:
+    """The provenance stamp every bench/serving/train summary record
+    carries (ISSUE 17): the normalized config, its fingerprint, and the
+    producing git rev. Merge into the record dict with `**run_stamp(...)`."""
+    cfg = normalize_config(config)
+    return {"config": cfg,
+            "config_fingerprint": config_fingerprint(cfg),
+            "git_rev": git_rev(repo)}
+
+
+# ------------------------------------------------- outage classification --
+
+def outage_reason(rec: Optional[dict],
+                  rc: Optional[int] = None) -> Optional[str]:
+    """THE outage classifier (ISSUE 17 satellite): one string naming why
+    this record is an outage, or None for a healthy record. Shared by
+    `pick_baseline` in scripts/check_bench_regression.py and by the
+    index — the two must never diverge on what counts as a baseline.
+
+    An outage: no parseable record at all, an `error` record
+    (backend_unavailable and friends), a wrapper whose command exited
+    rc != 0 (the BENCH_r02 lesson: a traceback tail parses to nothing),
+    or a record that carries no `metric` to compare."""
+    if rec is None:
+        if rc not in (None, 0):
+            return f"no parseable record (rc={rc})"
+        return "no parseable record"
+    if not isinstance(rec, dict):
+        return "record is not a JSON object"
+    if "error" in rec:
+        detail = rec.get("detail")
+        return f"{rec['error']}: {detail}" if detail else str(rec["error"])
+    if rc not in (None, 0):
+        return f"rc={rc}"
+    if "metric" not in rec:
+        return "record carries no metric"
+    return None
+
+
+def extract_record(text: str) -> Optional[dict]:
+    """LAST parseable JSON-object line carrying `metric` or `error` —
+    the same scan the regression gate's load_record does over bench.py
+    stdout tails (diagnostics print before the record line)."""
+    rec = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and ("metric" in obj or "error" in obj):
+            rec = obj
+    return rec
+
+
+def classify_path(path: str) -> Dict[str, Any]:
+    """Normalize ONE artifact file (BENCH/MULTICHIP wrapper, bare bench
+    record, or stdout capture) into {record, rc, tail, outage} — outage
+    is `outage_reason`'s verdict, never a re-implementation of it."""
+    try:
+        text = open(path, errors="replace").read()
+    except OSError as e:
+        return {"record": None, "rc": None, "tail": None,
+                "outage": f"unreadable: {e}"}
+    doc = None
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        pass
+    rc = None
+    tail = None
+    if isinstance(doc, dict) and ("rc" in doc or "tail" in doc) \
+            and "metric" not in doc and "error" not in doc:
+        # BENCH_rNN / MULTICHIP_rNN wrapper: {"n", "cmd", "rc", "tail",
+        # "parsed"} — the parsed record wins, else scan the tail
+        rc = doc.get("rc")
+        tail = doc.get("tail")
+        parsed = doc.get("parsed")
+        rec = parsed if isinstance(parsed, dict) else \
+            extract_record(tail or "")
+    elif isinstance(doc, dict):
+        rec = doc
+    else:
+        rec = extract_record(text)
+    return {"record": rec, "rc": rc, "tail": tail,
+            "outage": outage_reason(rec, rc=rc)}
+
+
+def backend_from_tail(tail: Optional[str]) -> Optional[str]:
+    """Backend name from a bench tail's "N device(s) [TPU v5 lite]"
+    banner line, or None."""
+    if not tail:
+        return None
+    m = _BACKEND_RE.search(tail)
+    return m.group(1) if m else None
+
+
+# ------------------------------------------------------------- card builders --
+
+def _base_card(run: str, kind: str, source: str) -> Dict[str, Any]:
+    return {
+        "tag": "run_card",
+        "schema_version": EVENT_SCHEMA_VERSION,
+        "run_card_version": RUN_CARD_VERSION,
+        "run": run,
+        "kind": kind,
+        "source": source,
+        "outage": False,
+        "outage_reason": None,
+        "baseline_eligible": False,
+        "legacy": False,
+        "notes": [],
+        "backend": None,
+        "git_rev": None,
+        "config_fingerprint": None,
+        "config": None,
+        "metrics": {},
+        "measured_vs_analytic": None,
+        "controller": None,
+        "events": {},
+        "anomalies": {},
+        "ledger": {"decisions": 0, "applied": 0, "knobs": {}},
+        "captures": {"count": 0, "errors": 0, "triggers": {}},
+        "profile_phases": [],
+        "hbm": None,
+        "collectives": None,
+    }
+
+
+def _absorb_record(card: Dict[str, Any], rec: Optional[dict]) -> None:
+    """Fold one bench/serving record into a card: headline metrics, the
+    provenance stamp (or the loud legacy note), the measured reconcile,
+    and the controller summary."""
+    if not isinstance(rec, dict):
+        return
+    for f in HEADLINE_FIELDS:
+        if f in rec:
+            card["metrics"][f] = rec[f]
+    if "error" in rec:
+        card["metrics"].setdefault("error", rec["error"])
+    if isinstance(rec.get("measured_vs_analytic"), dict):
+        card["measured_vs_analytic"] = rec["measured_vs_analytic"]
+    ctl = rec.get("controller") or rec.get("tuning")
+    if isinstance(ctl, dict):
+        card["controller"] = {
+            "mode": ctl.get("mode"),
+            "decisions": ctl.get("decisions"),
+            "applied": ctl.get("applied"),
+            "last_knob": ctl.get("last_knob"),
+        }
+    if "config_fingerprint" in rec:
+        card["config_fingerprint"] = rec.get("config_fingerprint")
+        card["git_rev"] = rec.get("git_rev")
+        if isinstance(rec.get("config"), dict):
+            card["config"] = rec["config"]
+    else:
+        card["legacy"] = True
+        if LEGACY_NOTE not in card["notes"]:
+            card["notes"].append(LEGACY_NOTE)
+
+
+def card_from_record(rec: Optional[dict], run: str, source: str,
+                     kind: str = "bench", rc: Optional[int] = None,
+                     tail: Optional[str] = None) -> Dict[str, Any]:
+    """RunCard for one loose record (a gate's --fresh file, a wrapper's
+    parsed payload) — the shared path every other builder funnels into."""
+    card = _base_card(run, kind, source)
+    reason = outage_reason(rec, rc=rc)
+    card["outage"] = reason is not None
+    card["outage_reason"] = reason
+    card["baseline_eligible"] = reason is None
+    card["backend"] = backend_from_tail(tail)
+    _absorb_record(card, rec)
+    return card
+
+
+def card_from_bench_path(path: str) -> Dict[str, Any]:
+    """RunCard for a committed BENCH_rNN.json (or any single bench
+    artifact/stdout capture)."""
+    cls = classify_path(path)
+    run = os.path.splitext(os.path.basename(path))[0]
+    card = card_from_record(cls["record"], run=run, source=path,
+                            kind="bench", rc=cls["rc"], tail=cls["tail"])
+    if cls["rc"] is not None:
+        card["rc"] = cls["rc"]
+    return card
+
+
+def card_from_multichip_path(path: str) -> Dict[str, Any]:
+    """RunCard for a committed MULTICHIP_rNN.json wrapper ({"n_devices",
+    "rc", "ok", "skipped", "tail"}): a multichip probe that was skipped
+    or not-ok is an outage for baseline purposes like any rc != 0."""
+    cls = classify_path(path)
+    run = os.path.splitext(os.path.basename(path))[0]
+    card = card_from_record(cls["record"], run=run, source=path,
+                            kind="multichip", rc=cls["rc"],
+                            tail=cls["tail"])
+    try:
+        doc = json.loads(open(path, errors="replace").read())
+    except (OSError, ValueError):
+        doc = {}
+    if isinstance(doc, dict):
+        card["n_devices"] = doc.get("n_devices")
+        if doc.get("skipped") and not card["outage"]:
+            card["outage"] = True
+            card["outage_reason"] = "multichip probe skipped"
+            card["baseline_eligible"] = False
+    return card
+
+
+def _tally_events(card: Dict[str, Any], path: str) -> None:
+    """One metrics*.jsonl file into the card's event/anomaly/ledger/
+    capture tallies. Unparseable lines count under events['<invalid>']
+    — a corrupt writer shows up in the index, not as a crash."""
+    try:
+        lines = open(path, errors="replace").read().splitlines()
+    except OSError:
+        return
+    ev = card["events"]
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            ev["<invalid>"] = ev.get("<invalid>", 0) + 1
+            continue
+        if not isinstance(rec, dict) or "tag" not in rec:
+            ev["<invalid>"] = ev.get("<invalid>", 0) + 1
+            continue
+        tag = str(rec["tag"])
+        ev[tag] = ev.get(tag, 0) + 1
+        if tag.startswith(("sentinel/", "watchdog/")):
+            an = card["anomalies"]
+            an[tag] = an.get(tag, 0) + 1
+        elif tag in ("tuning_decision", "controller_decision"):
+            led = card["ledger"]
+            led["decisions"] += 1
+            if rec.get("applied"):
+                led["applied"] += 1
+            knob = rec.get("knob")
+            if knob is not None:
+                k = led["knobs"].setdefault(
+                    str(knob), {"count": 0, "applied": 0, "last": None})
+                k["count"] += 1
+                if rec.get("applied"):
+                    k["applied"] += 1
+                k["last"] = [rec.get("old"), rec.get("new")]
+        elif tag == "profile_attribution":
+            cap = card["captures"]
+            cap["count"] += 1
+            if rec.get("error"):
+                cap["errors"] += 1
+            trig = str(rec.get("trigger"))
+            cap["triggers"][trig] = cap["triggers"].get(trig, 0) + 1
+            phases = rec.get("phases")
+            if isinstance(phases, dict) and phases:
+                card["profile_phases"].append(
+                    {"phases": phases, "steps": rec.get("steps")})
+        elif tag == "hbm_watermark":
+            devices = rec.get("devices") or []
+            peaks = [d.get("peak_bytes_in_use") for d in devices
+                     if isinstance(d, dict)
+                     and isinstance(d.get("peak_bytes_in_use"),
+                                    (int, float))]
+            card["hbm"] = {"available": bool(rec.get("available")),
+                           "devices": len(devices),
+                           "peak_bytes": max(peaks) if peaks else None}
+
+
+def card_from_run_dir(rdir: str) -> Dict[str, Any]:
+    """RunCard for a runs/rN/ session dir: every bench_*.json arm is
+    classified (the card is an outage only if ALL arms are), metrics
+    jsonl events are tallied, flight dumps counted as anomalies, and the
+    graftcheck report becomes the collective inventory. A dir with no
+    bench artifacts (the staged-but-unrun r6–r17 backlog) is healthy but
+    not baseline-eligible — staged is not measured."""
+    rdir = rdir.rstrip("/")
+    card = _base_card(os.path.basename(rdir), "session", rdir)
+    arms: List[Dict[str, Any]] = []
+    for p in sorted(glob.glob(os.path.join(rdir, "bench_*.json"))):
+        cls = classify_path(p)
+        rec = cls["record"] or {}
+        arms.append({
+            "arm": os.path.splitext(os.path.basename(p))[0],
+            "outage": cls["outage"] is not None,
+            "outage_reason": cls["outage"],
+            "metric": rec.get("metric"),
+            "unit": rec.get("unit"),
+            "value": rec.get("value"),
+            "config_fingerprint": rec.get("config_fingerprint"),
+        })
+        if cls["outage"] is None:
+            if not card["baseline_eligible"]:
+                card["baseline_eligible"] = True
+                _absorb_record(card, rec)
+            card["backend"] = card["backend"] or \
+                backend_from_tail(cls["tail"])
+    card["arms"] = arms
+    if arms and all(a["outage"] for a in arms):
+        card["outage"] = True
+        card["outage_reason"] = "all bench arms are outages"
+    if not arms:
+        card["notes"].append("no bench artifacts — staged or unmeasured")
+    for p in sorted(glob.glob(os.path.join(rdir, "**", "metrics*.jsonl"),
+                              recursive=True)):
+        _tally_events(card, p)
+    flights = glob.glob(os.path.join(rdir, "**", "flightdump_*.json"),
+                        recursive=True)
+    if flights:
+        card["anomalies"]["flight_dumps"] = len(flights)
+    reports = sorted(glob.glob(os.path.join(rdir, "graftcheck*.json")))
+    if reports:
+        try:
+            rep = json.loads(open(reports[-1], errors="replace").read())
+        except (OSError, ValueError):
+            rep = None
+        if isinstance(rep, dict):
+            card["collectives"] = {
+                "ok": rep.get("ok"),
+                "violations": len(rep.get("violations") or []),
+                "contracts": {c.get("name"): c.get("ok")
+                              for c in rep.get("contracts") or []
+                              if isinstance(c, dict)},
+            }
+    return card
+
+
+def index_repo(repo: str) -> List[Dict[str, Any]]:
+    """Every run the repo knows about, one RunCard each: the committed
+    BENCH/MULTICHIP trajectory in round order, then runs/* session dirs."""
+    cards: List[Dict[str, Any]] = []
+    for p in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        cards.append(card_from_bench_path(p))
+    for p in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))):
+        cards.append(card_from_multichip_path(p))
+    for d in sorted(glob.glob(os.path.join(repo, "runs", "*"))):
+        if os.path.isdir(d):
+            cards.append(card_from_run_dir(d))
+    return cards
+
+
+# --------------------------------------------------------------- rendering --
+
+def _fmt_value(v: Any) -> str:
+    if isinstance(v, float):
+        return f"{v:,.3f}" if abs(v) < 1000 else f"{v:,.0f}"
+    return str(v)
+
+
+def format_card(card: Dict[str, Any]) -> List[str]:
+    """Human lines for one card (summarize_run / obs_diff stderr)."""
+    lines = []
+    status = f"OUTAGE ({card['outage_reason']})" if card["outage"] else (
+        "baseline-eligible" if card["baseline_eligible"] else "unmeasured")
+    lines.append(f"{card['run']} [{card['kind']}] — {status}")
+    fp = card.get("config_fingerprint")
+    rev = card.get("git_rev")
+    lines.append(f"  fingerprint {fp or '(unavailable)'}  "
+                 f"git {rev or '(unknown)'}"
+                 + (f"  backend {card['backend']}" if card.get("backend")
+                    else ""))
+    m = card.get("metrics") or {}
+    if m.get("metric") is not None:
+        lines.append(f"  {m.get('metric')}: "
+                     f"{_fmt_value(m.get('value'))} {m.get('unit', '')}")
+    for f in ("ttft_ms_p95", "tpot_ms_p95", "decode_hbm_bytes_per_step"):
+        if f in m:
+            lines.append(f"  {f}: {_fmt_value(m[f])}")
+    for arm in card.get("arms") or []:
+        tagline = (f"outage: {arm['outage_reason']}" if arm["outage"]
+                   else f"{_fmt_value(arm.get('value'))} "
+                        f"{arm.get('unit') or ''}")
+        lines.append(f"  arm {arm['arm']}: {tagline}")
+    led = card.get("ledger") or {}
+    if led.get("decisions"):
+        lines.append(f"  ledger: {led['decisions']} decision(s), "
+                     f"{led['applied']} applied "
+                     f"({', '.join(sorted(led['knobs']))})")
+    cap = card.get("captures") or {}
+    if cap.get("count"):
+        lines.append(f"  captures: {cap['count']} "
+                     f"({cap['errors']} errored)")
+    an = card.get("anomalies") or {}
+    if an:
+        lines.append("  anomalies: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(an.items())))
+    for note in card.get("notes") or []:
+        lines.append(f"  note: {note}")
+    return lines
+
+
+def _fields_missing(card: dict, fields: Tuple[str, ...]) -> List[str]:
+    return [f for f in fields if f not in card]
+
+
+def validate_card(card: dict) -> List[str]:
+    """Presence problems with one RunCard (mirrors schema.validate_record
+    for the run_card tag; used by tests and by consumers before keying)."""
+    if not isinstance(card, dict):
+        return ["card is not a JSON object"]
+    problems = [f"run_card: missing required field {f!r}" for f in
+                _fields_missing(card, ("tag", "run", "kind", "outage",
+                                       "baseline_eligible"))]
+    if card.get("tag") != "run_card":
+        problems.append(f"run_card: tag is {card.get('tag')!r}")
+    if card.get("outage") and card.get("baseline_eligible"):
+        problems.append("run_card: an outage can never be "
+                        "baseline_eligible")
+    return problems

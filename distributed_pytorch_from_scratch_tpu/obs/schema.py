@@ -29,8 +29,11 @@ from typing import Dict, List, Tuple
 # Version 5 = the ISSUE-16 control-plane family: the decision ledger
 # (tuning_decision / controller_decision) every --control advise/act
 # actuation lands in.
+# Version 6 = the ISSUE-17 run-forensics family: run_card (the archive
+# index's normalized per-run summary) and run_diff (the pairwise
+# forensic report obs_diff / check_bench_regression --explain emit).
 # (Version 1 is retroactively "any pre-versioned event".)
-EVENT_SCHEMA_VERSION = 5
+EVENT_SCHEMA_VERSION = 6
 
 # tag -> fields a consumer may key on (presence contract, not types).
 # Only EVENT tags appear here — scalar ({"tag", "value", "step"}) and text
@@ -82,6 +85,16 @@ EVENT_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # `snapshot_seq`, so the ledger can replay trigger -> action
     "controller_decision": ("knob", "old", "new", "trigger", "mode",
                             "applied", "snapshot_seq"),
+    # -- ISSUE 17: the run-forensics family ------------------------------
+    # one normalized run from the archive index (obs/runindex.py):
+    # consumers key on which run it is, what shape it came from
+    # (bench / multichip / session), and the outage classification —
+    # `outage` true means the card can NEVER be a baseline, and
+    # baseline_eligible makes that machine-checkable
+    "run_card": ("run", "kind", "outage", "baseline_eligible"),
+    # one pairwise forensic report (obs/rundiff.py): the config delta
+    # joined to its measured consequences, with the ranked suspects list
+    "run_diff": ("run_a", "run_b", "config_delta", "suspects"),
 }
 
 

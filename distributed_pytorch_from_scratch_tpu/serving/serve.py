@@ -29,6 +29,7 @@ import sys
 import dataclasses
 
 from ..cli import add_model_shape_args, build_model_config
+from ..obs.runindex import run_stamp
 from ..config import (BOS_TOKEN, EOS_TOKEN, MODEL_PRESETS, MeshConfig,
                       ModelConfig, model_preset)
 from ..runtime.mesh import make_mesh
@@ -743,6 +744,9 @@ def serve(args: argparse.Namespace) -> dict:
               + (f", {duty.windows_skipped} window(s) skipped after "
                  f"budget exhaustion" if duty.windows_skipped else ""),
               file=sys.stderr)
+    # ISSUE 17: provenance stamp (config fingerprint + git rev) — the
+    # run-forensics join key every summary record carries uniformly
+    rec.update(run_stamp(vars(args)))
     print(json.dumps(rec))
     return summary
 

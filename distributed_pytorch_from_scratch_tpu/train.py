@@ -36,6 +36,7 @@ from .data.dataset import get_dataloader
 from .data.prefetch import Prefetcher, stack_window, window_stream
 from .models.transformer import Transformer
 from .obs import TrainObserver, analyze_compiled, format_analysis
+from .obs.runindex import run_stamp
 from .runtime.mesh import (batch_feeder, init_multihost, make_mesh,
                            process_info)
 from .training.checkpoint import (latest_step, load_checkpoint,
@@ -1188,7 +1189,10 @@ def train(args: argparse.Namespace) -> dict:
                   f"data ({host_dispatches} dispatches; collate+stack ran on "
                   f"the prefetch thread)")
         print(f"training finished at step {n}, avg loss {final_avg:.4f}")
-        out = {"steps": n, "avg_loss": final_avg}
+        # ISSUE 17: provenance stamp — the run-forensics join key every
+        # summary record carries uniformly (bench/serve/train)
+        out = {"steps": n, "avg_loss": final_avg,
+               **run_stamp(vars(args))}
         if advisor is not None:  # zero-cost off: no field when off
             out["control"] = advisor.summary()
         return out

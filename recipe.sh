@@ -95,4 +95,11 @@ for TP in $TP_SIZES; do
         --tp_size "$TP" --ckpt_dir "$CKPT" \
         --data_path "$TOKENS" --tokenizer_path "$TOKENIZER"
 done
+
+# Final step (obs v6): stamp the work dir with its RunCard so this recipe
+# run is indexable/diffable like any bench session (ISSUE 17). Best-effort:
+# a forensics hiccup must not fail a completed recipe.
+echo "== RunCard: $WORK/run_card.json"
+python scripts/obs_diff.py --card "$WORK" > "$WORK/run_card.json" \
+    || echo "== RunCard emission failed (non-fatal)"
 echo "== recipe complete"

@@ -1,0 +1,74 @@
+#!/bin/bash
+# Round-18 run-forensics session (ISSUE 17): obs v6 — the run archive
+# becomes queryable. The r6–r17 backlog lands its numbers at this chip
+# window; this session proves the tooling that turns those records into
+# attributable conclusions, on the real archive:
+#   0. archive index — obs_diff --index walks the committed BENCH/
+#      MULTICHIP trajectory + every runs/ dir and emits one RunCard per
+#      run (r02–r05 classified as outages, never baseline-eligible).
+#      Runs BEFORE the probe: the index needs no chip, so even an
+#      outage window yields the artifact.
+#   1. static preflight — graftcheck layer 1 (the r17 convention).
+#   2. two profiled serving bench arms differing in ONE knob
+#      (--page_size 16 vs 64): the duty profiler gives each record a
+#      measured reconcile + capture variance (the noise floor), and the
+#      new provenance stamp (config fingerprint + git rev) makes the
+#      pair diffable.
+#   3. the pairwise diff — obs_diff arm A vs arm B: the page_size knob
+#      delta joined to the measured copy-phase delta, ranked suspects.
+#   4. gates — the real trajectory gate on the ps16 arm with --explain
+#      (if it goes red it ships its own forensic report), then a FORCED
+#      regression over the committed fixture pair at zero tolerance to
+#      demonstrate the --explain report end-to-end on chip logs (rc 1
+#      expected — not a session failure).
+#   5. triage + trajectory — obs_diff --triage auto-picks the best
+#      comparable baseline for the fresh arm; --trajectory runs the
+#      outage-aware changepoint test over the committed rounds.
+# Idempotent; reuses the round-5 session helpers.
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r18
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r18 forensics pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+
+# 0. the archive index (chip-independent — before the probe on purpose)
+python scripts/run_step.py --manifest "$M" --name index --timeout 240 -- \
+  python scripts/obs_diff.py --index > "$R/run_index.json" 2>> "$R/session.log"
+
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 1. static preflight: layer-1 sweep
+step graftcheck 240 python scripts/graftcheck.py --no-trace --json runs/r18/graftcheck.json
+
+# 2. two profiled serving arms, ONE knob apart (page_size -> the copy
+# phase, per the rundiff affinity map); the duty profiler rides so each
+# record carries measured_vs_analytic + the capture-variance noise floor
+bench_line fxps16 1500 --serving --profile_every 40 --profile_window 4 --obs_dir runs/r18/bench_obs_ps16 --page_size 16 --serve_requests 24 --slots 8 --prompt_len 64 --gen_tokens 128
+bench_line fxps64 1500 --serving --profile_every 40 --profile_window 4 --obs_dir runs/r18/bench_obs_ps64 --page_size 64 --serve_requests 24 --slots 8 --prompt_len 64 --gen_tokens 128
+
+# 3. the pairwise forensic diff: which phase paid for the page_size change
+python scripts/run_step.py --manifest "$M" --name armdiff --timeout 240 -- \
+  python scripts/obs_diff.py runs/r18/bench_fxps16.json runs/r18/bench_fxps64.json \
+  > "$R/arm_diff.json" 2>> "$R/session.log"
+
+# 4a. the real trajectory gate on the fresh arm — red ships its triage
+step gate 240 python scripts/check_bench_regression.py --fresh runs/r18/bench_fxps16.json --explain
+
+# 4b. forced regression over the committed fixture pair (zero tolerance):
+# the --explain forensic report demonstrated end-to-end; rc 1 EXPECTED
+step gateforced 240 python scripts/check_bench_regression.py --fresh tests/forensics_fixtures/run_b/bench_paged.json --baseline tests/forensics_fixtures/run_a/bench_paged.json --tol_pct 0 --tol_latency_pct 0 --explain || true
+
+# 5. triage (auto-picked comparable baseline) + the outage-aware
+# changepoint trajectory over the committed rounds
+python scripts/run_step.py --manifest "$M" --name triage --timeout 240 -- \
+  python scripts/obs_diff.py --triage runs/r18/bench_fxps16.json \
+  > "$R/triage.json" 2>> "$R/session.log"
+python scripts/run_step.py --manifest "$M" --name trajectory --timeout 240 -- \
+  python scripts/obs_diff.py --trajectory > "$R/trajectory.json" 2>> "$R/session.log"
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r18 forensics done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -38,6 +38,20 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+
+def _forensics():
+    """The stdlib obs forensics modules (ISSUE 17), loaded standalone —
+    the obs dir on sys.path, never the jax-heavy package import. This is
+    how `pick_baseline` shares ONE outage classifier with the run index
+    instead of re-implementing it."""
+    obs_dir = os.path.join(REPO, "distributed_pytorch_from_scratch_tpu",
+                           "obs")
+    if obs_dir not in sys.path:
+        sys.path.insert(0, obs_dir)
+    import rundiff
+    import runindex
+    return runindex, rundiff
+
 # metric field -> direction ("up" = bigger is better). `value` resolves
 # per-unit below. Tolerances are fractions of the baseline.
 LOWER_BETTER_UNITS = ("ms/step", "ms/step (analytic)")
@@ -99,15 +113,19 @@ def default_baselines():
 def pick_baseline(fresh, paths):
     """Most recent comparable committed record: same `unit`, exact
     `metric` string preferred (later rounds win either way); outage
-    records are skipped. Returns (record, path) or (None, None)."""
+    records are skipped. Returns (record, path) or (None, None).
+
+    What counts as an outage is decided by `obs/runindex.outage_reason`
+    — the SAME classifier the run-archive index uses (ISSUE 17): an
+    error record, an rc != 0 wrapper, or a metric-less record can never
+    become a baseline, and exactly one piece of code says so."""
+    runindex, _ = _forensics()
     best = exact = None
     for p in paths:
-        try:
-            rec = load_record(p)
-        except (OSError, SystemExit):
-            continue
-        if "error" in rec or "metric" not in rec:
+        cls = runindex.classify_path(p)
+        if cls["outage"] is not None:
             continue  # an outage is not a baseline
+        rec = cls["record"]
         if rec.get("unit") != fresh.get("unit"):
             continue
         best = (rec, p)
@@ -200,10 +218,21 @@ def parse_args(argv=None):
     p.add_argument("--tol_latency_pct", type=float, default=25.0,
                    help="latency / exposed-comm tolerance band (%% above "
                         "baseline that still passes)")
+    p.add_argument("--explain", action="store_true",
+                   help="on regression, attach the obs v6 forensic "
+                        "report (config-delta -> phase-delta suspects "
+                        "plus the trajectory changepoint for this "
+                        "metric's unit) under out['forensics'] and "
+                        "render it on stderr — a red gate ships its "
+                        "own triage, not a bare exit 1")
     args = p.parse_args(argv)
     if args.controller and args.baseline is not None:
         p.error("--controller gates one record's pre/post windows; "
                 "--baseline has no meaning there")
+    if args.controller and args.explain:
+        p.error("--explain diffs the fresh record against a baseline "
+                "record; the controller gate's windows live inside ONE "
+                "record — there is no pair to diff")
     return args
 
 
@@ -277,6 +306,25 @@ def run_controller(args) -> int:
     return 0
 
 
+def build_forensics(fresh, fresh_path, base_path, paths):
+    """The obs v6 forensic report a red gate ships with (--explain):
+    the baseline->fresh run diff (config delta joined to phase deltas,
+    ranked suspects) plus the trajectory changepoint report for this
+    metric's unit — so the operator sees not just THAT the gate is red
+    but which knob/run moved the metric."""
+    runindex, rundiff = _forensics()
+    fresh_card = runindex.card_from_bench_path(fresh_path)
+    fresh_card["run"] = "fresh"
+    base_card = runindex.card_from_bench_path(base_path)
+    doc = rundiff.diff_runs(base_card, fresh_card)
+    cards = [runindex.card_from_bench_path(p) for p in paths]
+    cards.append(fresh_card)
+    unit = fresh.get("unit")
+    traj = [t for t in rundiff.trajectory_report(cards)
+            if t["unit"] == unit]
+    return {"diff": doc, "trajectory": traj}
+
+
 def run(args) -> int:
     fresh = load_record(args.fresh)
     out = {"gate": "bench_regression", "fresh": args.fresh}
@@ -310,6 +358,10 @@ def run(args) -> int:
     checks, skipped = metric_checks(fresh, base, args.tol_pct,
                                     args.tol_latency_pct)
     regressions = [c for c in checks if not c["ok"]]
+    forensics = None
+    if regressions and args.explain:
+        forensics = build_forensics(fresh, args.fresh, base_path, paths)
+        out["forensics"] = forensics
     out.update(status="regression" if regressions else "ok",
                baseline=base_path, baseline_metric=base.get("metric"),
                checks=checks, skipped_fields=skipped)
@@ -326,6 +378,13 @@ def run(args) -> int:
     if regressions:
         print(f"gate: FAIL — {len(regressions)} metric(s) regressed vs "
               f"{base_path}", file=sys.stderr)
+        if forensics is not None:
+            _, rundiff = _forensics()
+            for line in rundiff.format_diff(forensics["diff"]):
+                print(f"gate: {line}", file=sys.stderr)
+            for line in rundiff.format_trajectory(
+                    forensics["trajectory"]):
+                print(f"gate: {line}", file=sys.stderr)
         return 1
     print(f"gate: PASS vs {base_path}", file=sys.stderr)
     return 0

@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""obs_diff — run forensics over the run archive (ISSUE 17, obs v6).
+
+The cross-run layer the r6–r17 backlog needs at the next chip window:
+index every recorded run into a RunCard, diff two runs (config delta
+joined to measured per-phase consequences, ranked suspects), and run
+the outage-aware trajectory changepoint test that names the run that
+moved each metric.
+
+Usage:
+    python scripts/obs_diff.py RUN_A RUN_B     # pairwise forensic diff
+    python scripts/obs_diff.py --index         # every run, one card each
+    python scripts/obs_diff.py --card runs/r13 # one RunCard (dir or file)
+    python scripts/obs_diff.py --triage fresh.json  # best comparable
+                                               # baseline + diff, for a
+                                               # failing gate
+    python scripts/obs_diff.py --trajectory    # changepoint triage over
+                                               # the committed trajectory
+
+RUN_A/RUN_B name a runs/rN dir, a BENCH_rNN.json / bench artifact path,
+or a bare round name (r13, BENCH_r02 — resolved against the repo).
+
+One machine-readable JSON line on stdout; human rendering on stderr
+(the summarize_run/check_bench_regression convention). Exit 0 on
+success, 2 on unresolvable inputs; --triage exits 0 even when no
+comparable baseline exists (that is an answer, not an error). Stdlib
+only — importable and runnable with no jax on the box.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OBS_DIR = os.path.join(REPO, "distributed_pytorch_from_scratch_tpu", "obs")
+
+
+def _modules():
+    """The stdlib obs modules, loaded standalone (the obs dir on
+    sys.path) so this script never imports the jax-heavy package."""
+    if OBS_DIR not in sys.path:
+        sys.path.insert(0, OBS_DIR)
+    import rundiff
+    import runindex
+    return runindex, rundiff
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("runs", nargs="*", metavar="RUN",
+                   help="two runs to diff: runs/rN dir, BENCH_rNN.json / "
+                        "bench artifact path, or a bare round name")
+    p.add_argument("--index", action="store_true",
+                   help="emit one RunCard per recorded run (committed "
+                        "BENCH/MULTICHIP trajectory + runs/* dirs)")
+    p.add_argument("--card", metavar="TARGET",
+                   help="emit the RunCard for one run dir or artifact "
+                        "(recipe.sh's final step)")
+    p.add_argument("--triage", metavar="FRESH",
+                   help="auto-pick the best comparable baseline for this "
+                        "fresh record (same unit, outages excluded, "
+                        "matching fingerprint preferred) and diff "
+                        "against it")
+    p.add_argument("--trajectory", action="store_true",
+                   help="outage-aware changepoint triage over the "
+                        "committed trajectory")
+    p.add_argument("--repo", default=REPO,
+                   help="repo root to index (default: this checkout)")
+    args = p.parse_args(argv)
+    modes = [bool(args.index), bool(args.card), bool(args.triage),
+             bool(args.trajectory), bool(args.runs)]
+    if sum(modes) != 1:
+        p.error("pick exactly one mode: RUN_A RUN_B, --index, --card, "
+                "--triage, or --trajectory")
+    if args.runs and len(args.runs) != 2:
+        p.error("pairwise mode takes exactly two runs (RUN_A RUN_B)")
+    return args
+
+
+def resolve_card(name, repo):
+    """A RunCard for whatever the operator named: an existing dir, an
+    existing file, or a bare round name resolved against the repo
+    (runs/<name>, BENCH_<name>.json, <name>.json). Returns None when
+    nothing matches — the caller reports, never tracebacks."""
+    runindex, _ = _modules()
+    if os.path.isdir(name):
+        return runindex.card_from_run_dir(name)
+    if os.path.isfile(name):
+        if "MULTICHIP" in os.path.basename(name):
+            return runindex.card_from_multichip_path(name)
+        return runindex.card_from_bench_path(name)
+    for cand in (os.path.join(repo, "runs", name),):
+        if os.path.isdir(cand):
+            return runindex.card_from_run_dir(cand)
+    for cand in (os.path.join(repo, name),
+                 os.path.join(repo, f"BENCH_{name}.json"),
+                 os.path.join(repo, f"{name}.json"),
+                 os.path.join(repo, f"BENCH_{name.upper()}.json")):
+        if os.path.isfile(cand):
+            return runindex.card_from_bench_path(cand)
+    return None
+
+
+def pick_triage_baseline(fresh_card, cards):
+    """Best comparable baseline for a fresh card: baseline-eligible only
+    (outage_reason-clean — the shared classifier already decided),
+    same metric unit, later runs win, matching config fingerprint
+    preferred (isolates a code delta), then exact metric string."""
+    unit = (fresh_card.get("metrics") or {}).get("unit")
+    metric = (fresh_card.get("metrics") or {}).get("metric")
+    fp = fresh_card.get("config_fingerprint")
+    best = by_metric = by_fp = None
+    for card in cards:
+        if not card.get("baseline_eligible"):
+            continue
+        m = card.get("metrics") or {}
+        if m.get("unit") != unit:
+            continue
+        best = card
+        if m.get("metric") == metric:
+            by_metric = card
+        if fp is not None and card.get("config_fingerprint") == fp:
+            by_fp = card
+    return by_fp or by_metric or best
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    runindex, rundiff = _modules()
+
+    if args.index:
+        cards = runindex.index_repo(args.repo)
+        print(json.dumps({"tag": "run_index", "cards": cards}))
+        for card in cards:
+            for line in runindex.format_card(card):
+                print(line, file=sys.stderr)
+        print(f"indexed {len(cards)} run(s) "
+              f"({sum(c['outage'] for c in cards)} outage(s), "
+              f"{sum(c['baseline_eligible'] for c in cards)} "
+              f"baseline-eligible)", file=sys.stderr)
+        return 0
+
+    if args.card:
+        card = resolve_card(args.card, args.repo)
+        if card is None:
+            print(f"obs_diff: cannot resolve {args.card!r} to a run",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(card))
+        for line in runindex.format_card(card):
+            print(line, file=sys.stderr)
+        return 0
+
+    if args.trajectory:
+        cards = [c for c in runindex.index_repo(args.repo)
+                 if c["kind"] == "bench"]
+        reports = rundiff.trajectory_report(cards)
+        print(json.dumps({"tag": "trajectory", "reports": reports}))
+        for line in rundiff.format_trajectory(reports):
+            print(line, file=sys.stderr)
+        return 0
+
+    if args.triage:
+        fresh = resolve_card(args.triage, args.repo)
+        if fresh is None:
+            print(f"obs_diff: cannot resolve {args.triage!r} to a run",
+                  file=sys.stderr)
+            return 2
+        base = pick_triage_baseline(fresh,
+                                    runindex.index_repo(args.repo))
+        if base is None:
+            print(json.dumps({"tag": "run_diff", "run_a": None,
+                              "run_b": fresh["run"], "config_delta": {},
+                              "suspects": [],
+                              "note": "no comparable baseline"}))
+            print(f"triage: no comparable baseline for {fresh['run']} "
+                  f"(unit "
+                  f"{(fresh.get('metrics') or {}).get('unit')!r}) — "
+                  f"every candidate is an outage or a different unit",
+                  file=sys.stderr)
+            return 0
+        doc = rundiff.diff_runs(base, fresh)
+        print(json.dumps(doc))
+        print(f"triage: baseline {base['run']} ({base['source']})",
+              file=sys.stderr)
+        for line in rundiff.format_diff(doc):
+            print(line, file=sys.stderr)
+        return 0
+
+    card_a = resolve_card(args.runs[0], args.repo)
+    card_b = resolve_card(args.runs[1], args.repo)
+    missing = [n for n, c in zip(args.runs, (card_a, card_b))
+               if c is None]
+    if missing:
+        print(f"obs_diff: cannot resolve {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    doc = rundiff.diff_runs(card_a, card_b)
+    print(json.dumps(doc))
+    for line in rundiff.format_diff(doc):
+        print(line, file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -635,6 +635,50 @@ def graftcheck_lines(rdir):
     return rows
 
 
+def lineage_lines(rdir):
+    """Run lineage (obs v6): this run's RunCard plus the diff against the
+    nearest comparable committed baseline — where this run SITS in the
+    archive, not just what it measured. Stdlib modules loaded standalone
+    (the obs dir on sys.path) so the section renders on a jax-less box."""
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    obs_dir = os.path.join(repo, "distributed_pytorch_from_scratch_tpu",
+                           "obs")
+    if obs_dir not in sys.path:
+        sys.path.insert(0, obs_dir)
+    try:
+        import rundiff
+        import runindex
+    except ImportError as e:  # a partial checkout must not kill the summary
+        return [f"- run-forensics modules unavailable ({e})"]
+    card = runindex.card_from_run_dir(rdir)
+    rows = [f"- {line}" for line in runindex.format_card(card)]
+    unit = (card.get("metrics") or {}).get("unit")
+    base = None
+    for p in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        cand = runindex.card_from_bench_path(p)
+        if not cand.get("baseline_eligible"):
+            continue  # the shared classifier: an outage is never a baseline
+        if (cand.get("metrics") or {}).get("unit") != unit:
+            continue
+        base = cand
+    if base is None:
+        rows.append("- nearest baseline: none comparable in the committed "
+                    "trajectory" + ("" if unit else " (run is unmeasured)"))
+        return rows
+    doc = rundiff.diff_runs(base, card)
+    rows.append(f"- nearest baseline: {base['run']} "
+                f"(git {doc.get('git_rev_a') or '?'} -> "
+                f"{doc.get('git_rev_b') or '?'})")
+    suspects = doc.get("suspects") or []
+    for s in suspects[:3]:
+        rows.append(f"  - suspect: {s['verdict']}")
+    if not suspects:
+        rows.append("  - no knob change joined to a significant phase "
+                    "delta vs the baseline")
+    return rows
+
+
 def manifest_failures(rdir):
     """Steps that failed, from the run_step manifest — forensics inline."""
     path = os.path.join(rdir, "session_manifest.jsonl")
@@ -733,6 +777,12 @@ def summarize(rdir):
         out.append("")
         out.append("Static contracts (scripts/graftcheck.py):")
         out.extend(gc)
+    lineage = lineage_lines(rdir)
+    if lineage:
+        out.append("")
+        out.append("Run lineage (obs v6: the RunCard + nearest-baseline "
+                   "diff — scripts/obs_diff.py for the full report):")
+        out.extend(lineage)
     drift = schema_warning_lines(rdir)
     if drift:
         out.append("")

@@ -230,6 +230,22 @@ CORE_LANE = {
     # ledger contracts, and the --controller window gate (whole file:
     # one tiny dry serve + one tiny replay serve, ~8 s)
     "test_control.py": None,
+    # obs v6 (ISSUE 17): run forensics — the fixture RunCard pins, the
+    # shared outage classifier + the real-r02 never-a-baseline pin, THE
+    # ranked-suspect acceptance pin (pages_per_block -> copy), the
+    # committed-trajectory changepoint pin, the schema-v6 contracts, and
+    # the --explain gate pair — all pure host, no compiles; the obs_diff
+    # CLI matrix + the serve stamp e2e stay in the default lane
+    "test_forensics.py": [
+        "test_run_card_pins_fixture_run_a",
+        "test_outage_classifier_is_shared_with_gate",
+        "test_bench_r02_outage_never_baseline",
+        "test_pinned_ranked_suspect_pages_per_block_to_copy",
+        "test_changepoint_flags_pinned_trajectory_step",
+        "test_schema_v6_forensics_contracts",
+        "test_gate_explain_attaches_forensics_on_failure",
+        "test_gate_explain_silent_on_pass",
+    ],
 }
 
 

@@ -37,7 +37,11 @@ R5 = os.path.join(REPO, "runs", "r5")
 # that parses, and the measured-ms regression gate,
 # r17 the control plane: advise-mode train window, act-mode serving
 # loadgen with a burst traffic shift, the off-mode zero-cost arm, and
-# the check_bench_regression --controller window gate)
+# the check_bench_regression --controller window gate,
+# r18 run forensics: the archive index over the real runs, two
+# profiled serving arms one knob apart + their pairwise diff, the
+# --explain gate on a forced regression, and the triage/trajectory
+# passes)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -49,7 +53,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r14"),
                             os.path.join(REPO, "runs", "r15"),
                             os.path.join(REPO, "runs", "r16"),
-                            os.path.join(REPO, "runs", "r17"))
+                            os.path.join(REPO, "runs", "r17"),
+                            os.path.join(REPO, "runs", "r18"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
@@ -193,7 +198,8 @@ def validate(argv):
     if prog.startswith("scripts/") and prog.endswith(".py"):
         name = os.path.basename(prog)[:-3]
         if name in ("tpu_checks", "make_image_corpus", "tune_flash_blocks",
-                    "check_bench_regression", "graftcheck", "obs_top"):
+                    "check_bench_regression", "graftcheck", "obs_top",
+                    "obs_diff"):
             mod = _load_script(name)
             return _parse_with(mod.parse_args, rest)
         if name == "run_step":
