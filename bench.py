@@ -208,6 +208,16 @@ def parse_args(argv=None):
                         "attribution's decode HBM bytes/step before and "
                         "after the gather copy. Non-TPU backends fall "
                         "back to gather with a one-time warning")
+    p.add_argument("--cp", type=int, default=1,
+                   help="--serving: context-parallel shards for the PAGED "
+                        "arm (ISSUE 18). The KV page pool shards over the "
+                        "'cp' mesh axis (per-chip KV bytes ~1/cp at equal "
+                        "context), chunked prefill rings the query chunk "
+                        "around cp, decode combines per-rank (out, lse) "
+                        "partials; greedy output token-identical to cp=1. "
+                        "cp > 1 adds a cp=1 arm at the SAME page-byte "
+                        "budget (record: cp_vs_cp1). The speculative "
+                        "drafter stays cp=1")
     p.add_argument("--trace_requests", action="store_true",
                    help="--serving: per-request span timelines on the "
                         "paged arm (obs/reqtrace.py) — request_trace "
@@ -337,6 +347,12 @@ def parse_args(argv=None):
                     "lands there)")
     if args.decode_weight_dtype != "native" and not args.serving:
         p.error("--decode_weight_dtype is a --serving knob")
+    if args.cp < 1:
+        p.error(f"--cp must be >= 1, got {args.cp}")
+    if args.cp > 1 and not args.serving:
+        p.error("--cp is a --serving knob (only the paged engine's KV "
+                "pool shards over 'cp'; training context parallel is "
+                "train.py's --cp_size)")
     if args.remat is None:
         # zero 3 pairs with remat: without it the gathered layer weights
         # would be saved as backward residuals (full replica again)
@@ -383,10 +399,11 @@ def parse_args(argv=None):
 
 
 def build_model(args, cfg, tp: int, remat: str = None, attn_impl: str = "auto",
-                attn_t_real: int = None):
+                attn_t_real: int = None, cp: int = 1):
     """The one family dispatch shared by the training/decode/breakdown
     paths (three copies had already diverged once)."""
-    kw = dict(tp_size=tp, attn_impl=attn_impl, attn_t_real=attn_t_real,
+    kw = dict(tp_size=tp, cp_size=cp, attn_impl=attn_impl,
+              attn_t_real=attn_t_real,
               sequence_parallel=args.sequence_parallel,
               tp_overlap=args.tp_overlap)
     if remat is not None:
@@ -611,7 +628,7 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
                          "--gen_tokens >= 1")
     if plen + gen + 2 > cfg.maxlen:
         cfg = dataclasses.replace(cfg, maxlen=plen + gen + 2)
-    model = build_model(args, cfg, tp)
+    model = build_model(args, cfg, tp, cp=args.cp)
     params = jax.device_put(model.init(jax.random.key(0)),
                             model.shardings(mesh))
     buf_len = plen + gen + 2
@@ -749,10 +766,25 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     hbm_kw = dict(slots=args.serve_requests,
                   max_pages=max_pages_per_slot, page_size=args.page_size,
                   kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
-                  live_tokens=args.serve_requests * (plen + gen // 2))
+                  live_tokens=args.serve_requests * (plen + gen // 2),
+                  cp=args.cp)
     decode_hbm = {impl: paged_decode_hbm_bytes(cfg, paged_attn=impl,
                                                **hbm_kw)
                   for impl in ("gather", "pallas")}
+
+    # ISSUE 18: prefill latency per prompt token (queue wait excluded) —
+    # the number the cp query ring must hold flat-or-better while
+    # per-chip KV bytes shrink ~1/cp; check_bench_regression gates it
+    # directionally (up = fail). TTFT minus queue wait still includes the
+    # decode dispatches interleaved into the chunked prefill — that IS
+    # the serving prefill cost, not a kernel microbenchmark.
+    def _prefill_ms_per_token(eng):
+        done = [r for r in eng.completed if r.ttft_s]
+        toks = sum(len(r.prompt) for r in done)
+        return round(sum(r.ttft_s - (r.queue_wait_s or 0.0)
+                         for r in done) * 1e3 / max(toks, 1), 4)
+
+    prefill_ms_per_token = _prefill_ms_per_token(paged)
 
     # ISSUE 15: measured attribution on the paged arm — the duty
     # profiler's last finished capture parsed and reconciled against the
@@ -817,6 +849,67 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
             paged_attn_impl="gather")
         gather_summary = run_loadgen(gather_eng, burst())
 
+    # (a''') the cp=1 arm of the long-context A/B (ISSUE 18): when --cp
+    # shards the page pool, rerun the SAME burst through a cp=1 engine at
+    # the SAME page-byte budget (num_pages unchanged — equal TOTAL pool
+    # bytes, so the ratio isolates the ring + combine overhead from any
+    # capacity effect). The record carries cp_vs_cp1 plus both sides'
+    # per-chip pool bytes, and the 1/cp per-chip shrink is ASSERTED (the
+    # bound 1/cp + 0.05 covers the per-rank scratch page), not narrated.
+    # the slot/one-shot baselines below always run cp=1 (the slot
+    # engine's per-slot caches replicate over cp — it refuses a cp>1
+    # model — and the one-shot batch decoder needs no page pool to
+    # shard); at cp>1 they reuse the cp=1 arm's model/mesh/params
+    slot_model, slot_mesh, slot_params = model, mesh, params
+    cp1_rec = {}
+    if args.cp > 1:
+        def _pool_bytes_per_chip(eng):
+            # page data only (pool.ks/vs); the tp head-axis sharding
+            # divides both sides equally, so it cancels in the ratio
+            total = sum(x.nbytes for x in
+                        jax.tree.leaves((eng.pool.ks, eng.pool.vs)))
+            return total // (max(1, eng.pool.cp) * tp)
+
+        mesh1 = make_mesh(MeshConfig(dp=1, tp=tp))
+        model1 = build_model(args, cfg, tp)
+        params1 = jax.device_put(model1.init(jax.random.key(0)),
+                                 model1.shardings(mesh1))
+        cp1_eng = PagedEngine(
+            model1, mesh1, params1, num_slots=args.serve_requests,
+            buf_len=buf_len, eos_id=eos, page_size=args.page_size,
+            num_pages=num_pages, prefill_chunk=args.prefill_chunk,
+            kv_dtype=kv_dtype, decode_weight_dtype=wdtype,
+            paged_attn_impl=args.paged_attn)
+        cp1_summary = run_loadgen(cp1_eng, burst())
+        slot_model, slot_mesh, slot_params = model1, mesh1, params1
+        chip_cp = _pool_bytes_per_chip(paged)
+        chip_cp1 = _pool_bytes_per_chip(cp1_eng)
+        bytes_ratio = chip_cp / max(chip_cp1, 1)
+        bound = 1.0 / args.cp + 0.05
+        if bytes_ratio > bound:
+            raise SystemExit(
+                f"bench[serving]: per-chip KV-pool bytes at cp={args.cp} "
+                f"are {bytes_ratio:.3f}x the cp=1 pool at equal "
+                f"page-byte budget (bound {bound:.2f}) — the cp sharding "
+                f"is not delivering its 1/cp ({chip_cp} vs {chip_cp1} "
+                f"bytes)")
+        cp1_rec = {"cp_vs_cp1": {
+            "tokens_per_sec_ratio": round(
+                paged_rate / max(cp1_summary["tokens_per_sec"], 1e-9), 3),
+            "cp1_rate": cp1_summary["tokens_per_sec"],
+            "cp1_ttft_ms_p95": cp1_summary["ttft_ms_p95"],
+            "cp1_tpot_ms_p95": cp1_summary["tpot_ms_p95"],
+            "cp1_prefill_ms_per_token": _prefill_ms_per_token(cp1_eng),
+            "kv_pool_bytes_per_chip": chip_cp,
+            "cp1_kv_pool_bytes_per_chip": chip_cp1,
+            "pool_bytes_per_chip_ratio": round(bytes_ratio, 4),
+        }}
+        print(f"bench[serving]: cp={args.cp} {paged_rate:.0f} tok/s vs "
+              f"cp=1 {cp1_summary['tokens_per_sec']:.0f} tok/s at equal "
+              f"page-byte budget; per-chip pool bytes "
+              f"{chip_cp / 1e6:.1f} MB vs {chip_cp1 / 1e6:.1f} MB "
+              f"({bytes_ratio:.2f}x, bound {bound:.2f})", file=sys.stderr)
+
     # (a') the speculative arm at the SAME byte budget: the drafter's pages
     # buy acceptance, not capacity, so they are paid for by SHRINKING the
     # target pool — budget_bytes = slots x buf_len target-token bytes,
@@ -864,15 +957,15 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
 
     # (b) the PR 5 slot engine
     engine = ContinuousBatchingEngine(
-        model, mesh, params, num_slots=args.slots, buf_len=buf_len,
-        eos_id=eos, prefill_bucket=128)
+        slot_model, slot_mesh, slot_params, num_slots=args.slots,
+        buf_len=buf_len, eos_id=eos, prefill_bucket=128)
     summary = run_loadgen(engine, burst())
     serve_rate = summary["tokens_per_sec"]
 
     # (c) one-shot baseline: the same prompts in GreedyDecoder batches of
     # --slots (the final ragged batch repeats its last prompt to keep one
     # compiled shape; pad-row outputs are not counted)
-    dec = GreedyDecoder(model, mesh, buf_len)
+    dec = GreedyDecoder(slot_model, slot_mesh, buf_len)
     prompts = [r.prompt for r in burst()]
     B = args.slots
     t0 = time.time()
@@ -882,7 +975,8 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         real = len(chunk)
         chunk = chunk + [chunk[-1]] * (B - real)
         limits = np.asarray([len(p) + gen for p in chunk], np.int32)
-        gens = dec.decode_batch(params, chunk, eos, max_total_len=limits)
+        gens = dec.decode_batch(slot_params, chunk, eos,
+                                max_total_len=limits)
         oneshot_tokens += sum(len(g) for g in gens[:real])
     oneshot_s = time.time() - t0
     oneshot_rate = oneshot_tokens / max(oneshot_s, 1e-9)
@@ -960,6 +1054,7 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
                    + f"PAGED at {num_pages}x{args.page_size}-token pages = "
                    + (f"{paged_attn} attn, " if paged_attn != "gather"
                       else "")
+                   + (f"cp{args.cp} page shard, " if args.cp > 1 else "")
                    + f"slots{args.slots} HBM, {args.serve_requests}-request "
                    f"long/short burst, prompt {max(3, plen // 4)}/{plen}, "
                    f"gen {gen}; vs_baseline = speedup over one-shot "
@@ -979,6 +1074,14 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
         "decode_weight_dtype": args.decode_weight_dtype,
         "num_pages": num_pages,
         "kv_capacity_ratio": kv_capacity_ratio,
+        # ISSUE 18: the resolved cp + per-chip page count; at cp > 1
+        # prefill_ms_per_token is the number the query ring must hold
+        # flat-or-better and cp_vs_cp1 the equal-page-byte-budget A/B
+        # (per-chip pool bytes asserted <= 1/cp + 0.05 of the cp=1 arm)
+        "cp": args.cp,
+        "pages_per_rank": paged.pool.pages_per_rank,
+        "prefill_ms_per_token": prefill_ms_per_token,
+        **cp1_rec,
         # paged-attention kernel A/B (ISSUE 14): the impl that actually
         # ran, the analytic decode-dispatch HBM bytes for BOTH impls
         # (obs/attribution.paged_decode_hbm_bytes — the gather-copy
@@ -1399,7 +1502,7 @@ def main(argv=None):
     # tunnel drop at a known fingerprint is still forensic evidence
     n_dev = _discover_backend(timeout_s=timeout_s,
                               stamp=run_stamp(vars(args)))
-    tp = args.tp or max(1, n_dev // args.dp)
+    tp = args.tp or max(1, n_dev // (args.dp * args.cp))
     if args.dp_reduce_bucket_mb and tp > 1 and not args.sequence_parallel:
         # fail HERE with the same clean message train.py gives — inside
         # build() the ValueError would be retried through every
@@ -1423,7 +1526,7 @@ def main(argv=None):
         # pure host math — no mesh, so `--tp 4 --analytic` prices a 4-chip
         # overlapped config from a 1-chip (or CPU) box
         return run_breakdown(args, None, cfg, tp)
-    mesh = make_mesh(MeshConfig(dp=args.dp, tp=tp))
+    mesh = make_mesh(MeshConfig(dp=args.dp, cp=args.cp, tp=tp))
     if args.remat == "auto":
         from distributed_pytorch_from_scratch_tpu.training.memory import (
             select_remat)

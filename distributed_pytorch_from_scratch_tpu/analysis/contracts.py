@@ -288,6 +288,36 @@ def check_no_wide_dp_wire(prog, axis: str = "dp",
         f"payload is 8-bit", prog.name)
 
 
+def check_cp_no_page_gather(prog) -> dict:
+    """cp-sharded paged serving (ISSUE 18): no cp-axis collective may
+    carry a pool-slab-scale payload — page DATA stays rank-local by
+    construction; the wire moves only the prefill query carry
+    (collective-permute) and the small (out, lse) combine psums. A
+    slab-scale cp gather would be the whole-pool materialisation the
+    shard exists to eliminate — the ZeRO-3 whole-tree-gather rule,
+    transplanted to pages. Threshold: half one rank's slab bytes
+    (`pool_bytes_per_rank` from the program config)."""
+    threshold = max(prog.config.get("pool_bytes_per_rank", 0) // 2,
+                    SCALE_SIDECAR_BYTES)
+    colls = parse_collectives_by_axis(prog.compiled_text, prog.mesh)
+    cp_colls = [c for c in colls if c.axis == "cp"]
+    big = [c for c in cp_colls if c.bytes >= threshold]
+    if big:
+        worst = max(big, key=lambda c: c.bytes)
+        return _result(
+            "cp-no-page-gather", False,
+            f"{len(big)} slab-scale cp collective(s) — largest {worst.op} "
+            f"{worst.dtype} {worst.bytes}B >= {threshold}B (half the "
+            f"local pool slab): page data is crossing the cp wire instead "
+            f"of staying rank-local", prog.name)
+    return _result(
+        "cp-no-page-gather", True,
+        f"largest cp payload {max((c.bytes for c in cp_colls), default=0)}"
+        f"B < {threshold}B (half the local pool slab): the cp wire "
+        f"carries query-carry/combine traffic only "
+        f"({len(cp_colls)} cp collective(s))", prog.name)
+
+
 def check_zero3_no_whole_tree_gather(prog) -> dict:
     """ZeRO-3: no dp-axis all-gather at all — the per-layer ring is
     collective-permute inside the scan; a dp all-gather is the whole-tree
@@ -378,10 +408,23 @@ def check_stable_lowering(name: str, texts: List[str]) -> dict:
 
 # ------------------------------------------------------------ the runner --
 
+#: Program.config keys that parameterise CONTRACTS (thresholds), not the
+#: expected_collectives schedule — stripped before the schedule call
+_NON_SCHEDULE_KEYS = ("pool_bytes_per_rank",)
+
+
+def _expected(prog) -> Dict:
+    from ..obs.attribution import expected_collectives
+    return expected_collectives(**{k: v for k, v in prog.config.items()
+                                   if k not in _NON_SCHEDULE_KEYS})
+
+
 def run_trace_contracts(full: bool = False) -> List[dict]:
     """Build the canonical programs and run every contract. `full` adds
-    the slower sweep (all zero stages x wires, spec verify); the default
-    set covers the acceptance contracts in ~4 compiles."""
+    the slower sweep (all zero stages x wires, spec verify, the pallas
+    cp variants); the default set covers the acceptance contracts —
+    including the cp=2 serving ring inventory + page-locality canary
+    (ISSUE 18)."""
     from . import programs as P
     from ..obs.attribution import expected_collectives
 
@@ -429,6 +472,15 @@ def run_trace_contracts(full: bool = False) -> List[dict]:
             "paged_decode" + ("" if impl == "gather" else f"_{impl}"),
             _decode_lowerings(paged_attn=impl)))
 
+    # cp-sharded serving (ISSUE 18) rides the DEFAULT set — the ring
+    # inventory (decode combine psums; prefill ring permutes + reassembly)
+    # and the page-locality canary are acceptance contracts
+    for prog in (P.paged_decode_program(cp=2),
+                 P.prefill_chunk_program(cp=2)):
+        results.append(check_collective_inventory(prog, _expected(prog)))
+        results.append(check_donation_aliased(prog))
+        results.append(check_cp_no_page_gather(prog))
+
     if full:
         for impl in ("gather", "pallas"):
             chunk = P.prefill_chunk_program(paged_attn=impl)
@@ -439,6 +491,15 @@ def run_trace_contracts(full: bool = False) -> List[dict]:
             results.append(check_donation_aliased(ver))
             results.append(check_collective_inventory(
                 ver, expected_collectives(**ver.config)))
+        # the pallas cp variants + the cp spec verify (target sharded,
+        # drafter cp=1) must satisfy the same cp schedule and canary
+        for prog in (P.paged_decode_program(paged_attn="pallas", cp=2),
+                     P.prefill_chunk_program(paged_attn="pallas", cp=2),
+                     P.speculative_verify_program(cp=2)):
+            results.append(check_collective_inventory(prog,
+                                                      _expected(prog)))
+            results.append(check_donation_aliased(prog))
+            results.append(check_cp_no_page_gather(prog))
     return results
 
 

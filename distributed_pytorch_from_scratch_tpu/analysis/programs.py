@@ -141,7 +141,7 @@ def train_step_refuses(zero_stage: int, wire: str,
 
 @functools.lru_cache(maxsize=8)
 def _paged_engine(tp: int = 2, speculative: bool = False,
-                  paged_attn: str = "gather"):
+                  paged_attn: str = "gather", cp: int = 1):
     import jax
 
     from ..config import MeshConfig
@@ -150,8 +150,8 @@ def _paged_engine(tp: int = 2, speculative: bool = False,
     from ..serving.engine import PagedEngine
 
     cfg = _tiny_model_cfg(maxlen=64)
-    mesh = make_mesh(MeshConfig(dp=1, tp=tp))
-    model = Transformer(cfg, tp_size=tp)
+    mesh = make_mesh(MeshConfig(dp=1, cp=cp, tp=tp))
+    model = Transformer(cfg, tp_size=tp, cp_size=cp)
     params = jax.device_put(model.init(jax.random.key(7)),
                             model.shardings(mesh))
     # the pallas variant lowers through the Pallas interpreter on the
@@ -161,6 +161,8 @@ def _paged_engine(tp: int = 2, speculative: bool = False,
               paged_attn_interpret=paged_attn == "pallas")
     if speculative:
         from ..serving.speculative import SpeculativeEngine
+        # the drafter stays cp=1 by contract (its pool replicates over
+        # the cp axis) — only the TARGET's pages shard
         dmodel = Transformer(cfg, tp_size=tp)
         dparams = jax.device_put(dmodel.init(jax.random.key(9)),
                                  dmodel.shardings(mesh))
@@ -170,6 +172,15 @@ def _paged_engine(tp: int = 2, speculative: bool = False,
                                  prefill_chunk=4, **kw)
     return PagedEngine(model, mesh, params, num_slots=2, buf_len=32,
                        eos_id=1, page_size=8, prefill_chunk=4, **kw)
+
+
+def _pool_bytes_per_rank(eng) -> int:
+    """One cp rank's slab of the KV pool in bytes — the scale the
+    cp-no-page-gather canary thresholds against."""
+    import jax
+    total = sum(x.nbytes
+                for x in jax.tree.leaves((eng.pool.ks, eng.pool.vs)))
+    return total // max(1, eng.pool.cp)
 
 
 def _engine_step_args(eng):
@@ -190,24 +201,34 @@ def _finish(name, eng, fn, args, donate_argnums, config) -> Program:
 
 
 @functools.lru_cache(maxsize=8)
-def paged_decode_program(tp: int = 2, paged_attn: str = "gather") -> Program:
+def paged_decode_program(tp: int = 2, paged_attn: str = "gather",
+                         cp: int = 1) -> Program:
     """The paged decode step exactly as PagedEngine compiles it (donated
     KV pool halves, per-row cursors over the page table). `paged_attn`
     selects the attend impl — the 'pallas' variant must satisfy the SAME
-    collective schedule (the kernel changes HBM traffic, never the wire)."""
-    eng = _paged_engine(tp, paged_attn=paged_attn)
-    cfg = dict(serving=True, tp=tp, dp=1, kind="decode")
+    collective schedule (the kernel changes HBM traffic, never the wire).
+    `cp` > 1 shards the page pool over the cp axis (ISSUE 18): the config
+    carries `pool_bytes_per_rank` so the page-locality canary can
+    threshold against the slab size."""
+    eng = _paged_engine(tp, paged_attn=paged_attn, cp=cp)
+    cfg = dict(serving=True, tp=tp, dp=1, cp=cp, kind="decode")
+    if cp > 1:
+        cfg["pool_bytes_per_rank"] = _pool_bytes_per_rank(eng)
     suffix = "" if paged_attn == "gather" else f"_{paged_attn}"
+    suffix += f"_cp{cp}" if cp > 1 else ""
     return _finish(f"paged_decode_tp{tp}{suffix}", eng, eng._step_fn,
                    _engine_step_args(eng), (1, 2), cfg)
 
 
 @functools.lru_cache(maxsize=8)
 def prefill_chunk_program(tp: int = 2, cw: int = 4,
-                          paged_attn: str = "gather") -> Program:
-    """One chunked-prefill dispatch (width cw) from the paged engine."""
+                          paged_attn: str = "gather",
+                          cp: int = 1) -> Program:
+    """One chunked-prefill dispatch (width cw) from the paged engine. At
+    cp > 1 the dispatch rings the query chunk around the cp axis (cw must
+    divide by cp, as the engine guarantees)."""
     import jax.numpy as jnp
-    eng = _paged_engine(tp, paged_attn=paged_attn)
+    eng = _paged_engine(tp, paged_attn=paged_attn, cp=cp)
     fn = eng._build_chunk(cw)
     n = eng.num_slots
     args = (eng._params_in, eng.pool.ks, eng.pool.vs,
@@ -215,35 +236,47 @@ def prefill_chunk_program(tp: int = 2, cw: int = 4,
             jnp.zeros((n,), jnp.int32), jnp.asarray(eng._tbl),
             jnp.zeros((n, cw), jnp.int32), jnp.zeros((n, cw), jnp.int32),
             jnp.asarray(eng._seeds))
-    cfg = dict(serving=True, tp=tp, dp=1, kind="prefill_chunk")
+    cfg = dict(serving=True, tp=tp, dp=1, cp=cp, kind="prefill_chunk")
+    if cp > 1:
+        cfg["pool_bytes_per_rank"] = _pool_bytes_per_rank(eng)
     suffix = "" if paged_attn == "gather" else f"_{paged_attn}"
+    suffix += f"_cp{cp}" if cp > 1 else ""
     return _finish(f"prefill_chunk_tp{tp}_w{cw}{suffix}", eng, fn, args,
                    (1, 2), cfg)
 
 
 @functools.lru_cache(maxsize=8)
 def speculative_verify_program(tp: int = 2, k: int = 2,
-                               paged_attn: str = "gather") -> Program:
+                               paged_attn: str = "gather",
+                               cp: int = 1) -> Program:
     """The speculative engine's K+1 verify dispatch (target scores k+1
-    positions through the page table in one program)."""
+    positions through the page table in one program). At cp > 1 the
+    verify window pads to a cp multiple and rides the prefill ring
+    (target pages cp-sharded, drafter cp=1 by contract)."""
     import jax.numpy as jnp
-    eng = _paged_engine(tp, speculative=True, paged_attn=paged_attn)
+    eng = _paged_engine(tp, speculative=True, paged_attn=paged_attn,
+                        cp=cp)
     fn = eng._verify_fn
     n = eng.num_slots
     w = k + 1
     # greedy verify signature (speculative.py's round loop): params, pool
     # halves, pending tokens, the k drafts, cursors, window lengths, page
-    # table, per-position dest page/offset, seeds
+    # table, per-position dest page/offset, seeds. The dest vectors span
+    # the engine's (cp-padded) verify width.
+    vw = getattr(eng, "_vw", w)
     args = (eng._params_in, eng.pool.ks, eng.pool.vs,
             jnp.zeros((n,), jnp.int32),             # pending token
             jnp.zeros((n, k), jnp.int32),           # drafted tokens
             jnp.zeros((n,), jnp.int32),             # pos
             jnp.ones((n,), jnp.int32),              # qlen
             jnp.asarray(eng._tbl),
-            jnp.zeros((n, w), jnp.int32), jnp.zeros((n, w), jnp.int32),
+            jnp.zeros((n, vw), jnp.int32), jnp.zeros((n, vw), jnp.int32),
             jnp.asarray(eng._seeds))
-    cfg = dict(serving=True, tp=tp, dp=1, kind="spec_verify")
+    cfg = dict(serving=True, tp=tp, dp=1, cp=cp, kind="spec_verify")
+    if cp > 1:
+        cfg["pool_bytes_per_rank"] = _pool_bytes_per_rank(eng)
     suffix = "" if paged_attn == "gather" else f"_{paged_attn}"
+    suffix += f"_cp{cp}" if cp > 1 else ""
     return _finish(f"spec_verify_tp{tp}_k{k}{suffix}", eng, fn, args,
                    (1, 2), cfg)
 

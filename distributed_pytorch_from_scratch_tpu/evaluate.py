@@ -31,7 +31,6 @@ import numpy as np
 from .cli import add_model_shape_args, build_model_config
 from .config import BOS_TOKEN, EOS_TOKEN, IGNORE_INDEX, MeshConfig
 from .data.dataset import get_dataloader
-from .models.decode import GreedyDecoder
 from .models.transformer import Transformer
 from .obs import SpanTracer
 from .runtime.mesh import batch_feeder, init_multihost, make_mesh
@@ -60,10 +59,12 @@ def get_eval_args(argv=None) -> argparse.Namespace:
                         "(ragged final batches are padded with IGNORE_INDEX "
                         "rows, which the masked CE mean drops exactly)")
     g.add_argument("--cp_size", type=int, default=1,
-                   help="context-parallel axis: the validation forward AND "
-                        "the KV decoder's prefill shard the sequence over "
-                        "'cp' (ring attention; contiguous layout — zigzag "
-                        "or --no_kv_cache decode on the cp=1 path)")
+                   help="context-parallel axis: the validation forward "
+                        "shards the sequence over 'cp' (ring attention), "
+                        "and decoding routes through the PAGED serving "
+                        "engine's cp-sharded page pool (ring chunked "
+                        "prefill + cp-local decode; contiguous layout — "
+                        "zigzag or --no_kv_cache decode on the cp=1 path)")
     g.add_argument("--cp_layout", choices=["contiguous", "zigzag"],
                    default="contiguous",
                    help="sequence layout over the cp ring (see train.py)")
@@ -230,29 +231,25 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
         buf_len = cap
 
     cp = getattr(model, "cp_size", 1)
-    if cp > 1 and buf_len % cp:
-        # cp-sharded prefill needs contiguous equal chunks; pad up unless
-        # a learned position table caps the buffer, then step down
-        buf_len += cp - buf_len % cp
-        if cap is not None and buf_len > cap:
-            buf_len -= cp
-            longest = max(len(i) for i in encoded.values())
-            if buf_len < longest + 2:
-                raise SystemExit(
-                    f"cp_size {cp} chunking cannot fit the prompts "
-                    f"({longest + 2} positions) under the learned position "
-                    f"table ({cap}); reduce --cp_size or --max_decode_len")
 
-    if use_kv_cache and cp == 1:
-        # continuous-batching engine (serving/engine.py): the prompts
-        # prefill in length buckets and share one compiled decode step —
-        # token-identical to the fused GreedyDecoder for greedy decode
-        # (tests/test_serving.py), and the eval CLI exercises the same
+    if use_kv_cache:
+        # serving engines (serving/engine.py), one compiled decode step
+        # shared across prompts: at cp=1 the continuous-batching engine
+        # prefills in length buckets; at cp>1 the PAGED engine shards its
+        # page pool over 'cp' (ring chunked prefill + cp-local decode,
+        # each rank holding 1/cp of the KV pages — it rounds page budgets
+        # to cp multiples internally). Both are token-identical to the
+        # fused GreedyDecoder for greedy decode (tests/test_serving.py,
+        # tests/test_serving_cp.py), and the eval CLI exercises the same
         # lowering production serving uses.
-        from .serving.engine import ContinuousBatchingEngine, decode_prompts
+        if cp > 1:
+            from .serving.engine import PagedEngine as _Engine
+        else:
+            from .serving.engine import ContinuousBatchingEngine as _Engine
+        from .serving.engine import decode_prompts
 
         prompts = [[bos_id] + encoded[t] for t in texts]
-        engine = ContinuousBatchingEngine(
+        engine = _Engine(
             model, mesh, params, num_slots=min(len(prompts), 8),
             buf_len=buf_len, eos_id=eos_id, temperature=temperature,
             top_k=top_k, top_p=top_p)
@@ -261,17 +258,6 @@ def greedy_decode(model: Transformer, mesh, params, tokenizer, prompts,
             engine, prompts,
             [max(0, max_decode_len + 1 - len(pr)) for pr in prompts],
             base_seed=seed)
-        decoded_texts = [tokenizer.decode(encoded[t] + gen).strip()
-                         for t, gen in zip(texts, gens)]
-    elif use_kv_cache:
-        # cp-sharded ring prefill: the fused whole-generation decoder
-        # (the serving engine decodes on the cp=1 path only)
-        decoder = GreedyDecoder(model, mesh, buf_len,
-                                temperature=temperature, top_k=top_k,
-                                top_p=top_p)
-        gens = decoder.decode_batch(
-            params, [[bos_id] + encoded[t] for t in texts], eos_id,
-            max_total_len=max_decode_len + 1, seed=seed)
         decoded_texts = [tokenizer.decode(encoded[t] + gen).strip()
                          for t, gen in zip(texts, gens)]
     else:
@@ -340,16 +326,18 @@ def evaluate(args: argparse.Namespace) -> dict:
     if args.cp_size > 1 and args.cp_impl == "ulysses" \
             and not args.no_kv_cache:
         # VERDICT r5 #5: refuse loudly instead of silently requiring the
-        # ring path — the decoder's cp prefill is ring-only
-        # (models/decode.py::_prefill_cp), and a ulysses-trained config
-        # would otherwise just crash deeper in with an opaque ValueError.
+        # ring path — cp decoding (the paged engine's query ring over
+        # cp-local pages) runs the ring schedule only, and a ulysses-
+        # trained config would otherwise crash deeper in with an opaque
+        # ValueError.
         raise SystemExit(
-            f"--cp_impl ulysses has no KV-decode path (the cp prefill is "
-            f"ring-only, models/decode.py::_prefill_cp). A ulysses-trained "
-            f"checkpoint is layout-identical to a ring one — cp_impl only "
-            f"changes the attention schedule — so rerun with --cp_impl "
-            f"ring, or --no_kv_cache, or --cp_size 1 (got --cp_size "
-            f"{args.cp_size})")
+            f"--cp_impl ulysses has no KV-decode path (cp decoding is "
+            f"ring-only: cp serving rings the prefill queries over "
+            f"cp-local pages). "
+            f"A ulysses-trained checkpoint is layout-identical to a ring "
+            f"one — cp_impl only changes the attention schedule — so rerun "
+            f"with --cp_impl ring, or --no_kv_cache, or --cp_size 1 (got "
+            f"--cp_size {args.cp_size})")
     mesh = make_mesh(MeshConfig(dp=args.dp_size, tp=args.tp_size,
                                 cp=args.cp_size))
     dataloader = get_dataloader(args.data_path, args.batch_size, IGNORE_INDEX,
@@ -358,11 +346,11 @@ def evaluate(args: argparse.Namespace) -> dict:
     vocab_size = dataloader.dataset.vocab_size
     cfg = build_model_config(args, vocab_size)
     # val loss runs the full dp x cp x tp mesh (pp/ep stay 1 at eval).
-    # Decoding: with the contiguous layout the KV decoder itself shards
-    # the prefill over 'cp' (ring attention, models/decode.py); the zigzag
-    # layout permutes the cache order, and the full-recompute path
-    # (--no_kv_cache) is single-device dense attention — both decode on
-    # the cp=1 path.
+    # Decoding: with the contiguous layout cp>1 routes through the paged
+    # serving engine (cp-sharded page pool, ring chunked prefill +
+    # cp-local decode); the zigzag layout permutes the cache order, and
+    # the full-recompute path (--no_kv_cache) is single-device dense
+    # attention — both decode on the cp=1 path.
     dec_cp = (args.cp_size if (args.cp_layout == "contiguous"
                                and not args.no_kv_cache) else 1)
     if args.family == "gpt2":

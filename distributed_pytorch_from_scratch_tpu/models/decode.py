@@ -43,9 +43,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import resolve_dtype
 from ..ops.attention import MASK_VALUE, causal_attention
-from ..ops.collectives import gather_from
+from ..ops.collectives import gather_from, ring_permute
 from ..ops.quant import quantize_rows
-from ..ops.ring_attention import ring_attention
+from ..ops.ring_attention import _BIG_NEG, _block_attn_xla, ring_attention
 from ..ops.rope import apply_rotary, rope_tables
 from .transformer import NEG_INF, Transformer
 
@@ -350,11 +350,53 @@ def _gather_page_view(cache, page_tbl: jax.Array, dtype) -> jax.Array:
     return view.transpose(0, 2, 1, 3, 4).reshape(b, kvh, mp * ps, hd)
 
 
+def _cp_pool_view(pool_k, page_tbl, page_size: int, cp: int):
+    """This cp rank's slice of the paged world (call inside shard_map with
+    a cp-sharded pool, ISSUE 18): the rank's `max_pages/cp` page-table
+    columns translated to LOCAL pool indices, the global position of its
+    first column, and the local real-page count.
+
+    Layout (kv_manager.PagedKVPool, cp > 1): page-table column j belongs
+    to rank j // (max_pages/cp) — contiguous position spans — and rank r's
+    local pool slab holds global pages [r*ppr, (r+1)*ppr) plus one local
+    scratch at index ppr; any id the rank does not own translates to that
+    scratch (`local_page_ids`), which visibility masks to zero weight."""
+    from ..serving.kv_manager import local_page_ids
+
+    mp = page_tbl.shape[1]
+    mpp = mp // cp                     # page-table columns per rank
+    ppr = (pool_k[0] if isinstance(pool_k, tuple)
+           else pool_k).shape[1] - 1   # local real pages (+1 = scratch)
+    r = lax.axis_index("cp")
+    tbl_r = lax.dynamic_slice_in_dim(page_tbl, r * mpp, mpp, axis=1)
+    to_local = lambda ids: local_page_ids(ids, ppr)
+    base = r * (mpp * page_size)       # global position of local column 0
+    return to_local(tbl_r), base, to_local
+
+
+def _cp_combine(o, lse, axis: str = "cp"):
+    """Merge per-rank partial attention (o f32-normalized within the rank,
+    lse over the rank's visible scores) into the exact global softmax —
+    ONE pmax + two psums of decode-step-sized tensors, never pages.
+
+    o_r = acc_r / l_r and lse_r = m_r + log l_r give
+    sum_r o_r * exp(lse_r - m) / sum_r exp(lse_r - m)
+      = sum_r acc_r * exp(m_r) / sum_r l_r * exp(m_r): the single-pool
+    softmax bit for bit up to float reassociation. Dead ranks (lse at the
+    -1e30 sentinel) underflow to exactly zero weight; an all-dead row
+    (free slot) returns 0 like the cp=1 path. The psum outputs are
+    cp-invariant, so the caller's residual stream stays replicated."""
+    m = lax.pmax(lse, axis)
+    w = jnp.exp(lse - m)               # all-dead rows: w = 1 on every rank
+    denom = lax.psum(w, axis)
+    return lax.psum(o * w[..., None], axis) / denom[..., None]
+
+
 def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
                       token: jax.Array, cur: jax.Array, page_tbl: jax.Array,
                       page_size: int, cos_t, sin_t, dtype,
                       attn_impl: str = "gather",
-                      attn_interpret: bool = False):
+                      attn_interpret: bool = False, cp: int = 1):
     """`_decode_one` through a page table: one single-token step where each
     row's K/V write lands in the PAGE mapped for its cursor position
     (pool.at[page, :, offset, :]) and the attention reads the row's page
@@ -373,7 +415,16 @@ def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
 
     pool_k/pool_v: (L, num_pages+1, kvh, page_size, hd); page_tbl:
     (b, max_pages) int32 page ids (free rows map every entry at the scratch
-    page, whose content is never attended)."""
+    page, whose content is never attended).
+
+    `cp > 1` (ISSUE 18): the pool is page-sharded over the 'cp' mesh axis
+    (kv_manager.CP_POOL_SPEC) and this function runs per-rank inside the
+    engine's shard_map. Each rank writes the token's K/V only if it owns
+    the cursor's page (everyone else scatters to their LOCAL scratch),
+    attends over its own `max_pages/cp` page-table columns with the rank's
+    global base as `pos_offset`, and the per-rank partial (out, lse) pairs
+    merge through `_cp_combine` — the step's only cp collective is that
+    decode-sized reduction; page data never moves."""
     b = token.shape[0]
     mp = page_tbl.shape[1]
     buf_len = mp * page_size
@@ -389,6 +440,15 @@ def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
     # offset inside that page (free rows' tables aim at the scratch page)
     dst_page = page_tbl[rows, cur // page_size]        # (b,)
     dst_off = cur % page_size                          # (b,)
+    if cp > 1:
+        tbl_cp, base_cp, to_local = _cp_pool_view(pool_k, page_tbl,
+                                                  page_size, cp)
+        # rows whose cursor page lives on another rank write their token's
+        # K/V to the local scratch — exactly one rank lands the real write
+        dst_page = to_local(dst_page)
+        t_cp = tbl_cp.shape[1] * page_size
+        kv_pos_cp = jnp.broadcast_to(
+            base_cp + jnp.arange(t_cp, dtype=jnp.int32), (b, t_cp))
 
     def write_cache(cache, z):
         # per-row scatter into the page pool (row i writes page dst_page[i]
@@ -410,9 +470,33 @@ def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
             # walk the page table in place (writes above land in the pool
             # first, so the pending token is visible like the gather path)
             from ..ops.pallas.paged_attention import paged_attention
-            o = paged_attention(q, k_cache, v_cache, page_tbl, cur,
-                                page_size=page_size,
-                                interpret=attn_interpret).astype(dtype)
+            if cp > 1:
+                # local columns only; pos_offset anchors this rank's pages
+                # at their global positions, so the kernel's causal mask and
+                # block-skip logic run unchanged against the local slab
+                o, olse = paged_attention(q, k_cache, v_cache, tbl_cp, cur,
+                                          page_size=page_size,
+                                          pos_offset=base_cp,
+                                          return_lse=True,
+                                          interpret=attn_interpret)
+                o = _cp_combine(o.astype(jnp.float32), olse).astype(dtype)
+            else:
+                o = paged_attention(q, k_cache, v_cache, page_tbl, cur,
+                                    page_size=page_size,
+                                    interpret=attn_interpret).astype(dtype)
+            x = _finish_block(model, lp, x, o, dtype)
+            return x, (k_cache, v_cache)
+        if cp > 1:
+            # per-rank partial over the local gathered view; the causal
+            # mask is positional (kv_pos carries the global base), dead
+            # ranks (cursor before their span) emit the lse sentinel and
+            # vanish in the combine
+            k_view = _gather_page_view(k_cache, tbl_cp, dtype)
+            v_view = _gather_page_view(v_cache, tbl_cp, dtype)
+            o, olse = _block_attn_xla(q, k_view, v_view, cur[:, None],
+                                      kv_pos_cp,
+                                      model.cfg.head_dim ** -0.5)
+            o = _cp_combine(o, olse).astype(dtype)     # (b, h, 1, hd)
             x = _finish_block(model, lp, x, o, dtype)
             return x, (k_cache, v_cache)
         k_view = _gather_page_view(k_cache, page_tbl, dtype)
@@ -437,6 +521,69 @@ def _paged_decode_one(model: Transformer, params: Params, pool_k, pool_v,
     return k_new, v_new, _logits_last(model, params, x, dtype)
 
 
+def _cp_ring_attend(q, k_cache, v_cache, tbl_cp, base_cp, kv_pos_cp,
+                    start, qlen, pos, page_size: int, cp: int, dtype,
+                    attn_impl: str, attn_interpret: bool):
+    """Ring the chunk's QUERIES around the cp axis over a page-sharded pool
+    (one layer's attend inside `_paged_prefill_chunk`, ISSUE 18).
+
+    Rank r starts with sub-block r of the chunk (cw/cp queries) and walks
+    cp hops: attend the carried sub-block against the rank's LOCAL pages —
+    partial out f32-normalized within the hop plus its lse — merge into the
+    carry by the logaddexp recurrence (ops/ring_attention.block_into), and
+    collective-permute the carry (queries, their global positions, out,
+    lse, chunk offset) one rank forward. After cp hops every sub-block has
+    visited every slab; a position-scatter + psum('cp') reassembles the
+    full (b, h, cw, hd) output, cp-invariant for the replicated residual
+    stream. Communication: (cp-1) ppermute hops of sub-block-sized carry +
+    one chunk-sized psum — pages never move.
+
+    Dead hops (no local position visible to a query) emit the -1e30 lse
+    sentinel and merge at exactly zero weight; a query dead on EVERY hop is
+    a pad column (>= qlen), whose finite garbage flows only into pad
+    logits, same as the cp=1 chunk."""
+    b, h, cw, hd = q.shape
+    cws = cw // cp
+    r = lax.axis_index("cp")
+    off = jnp.asarray(r * cws, jnp.int32)[None]          # (1,) carried
+    qh = lax.dynamic_slice_in_dim(q, r * cws, cws, axis=2)
+    qph = lax.dynamic_slice_in_dim(pos, r * cws, cws, axis=1)
+    zero = qh.astype(jnp.float32).sum() * 0.0            # cp-varying 0
+    o = jnp.zeros((b, h, cws, hd), jnp.float32) + zero
+    lse = jnp.full((b, h, cws), _BIG_NEG, jnp.float32) + zero
+    if attn_impl != "pallas":
+        k_view = _gather_page_view(k_cache, tbl_cp, dtype)
+        v_view = _gather_page_view(v_cache, tbl_cp, dtype)
+    for hop in range(cp):
+        if attn_impl == "pallas":
+            from ..ops.pallas.paged_attention import paged_attention
+            # the carried sub-block's queries sit at chunk offset off:
+            # global start start+off, per-row real length qlen-off (clipped
+            # to the sub-block); dead rows surface the lse sentinel
+            bo, blse = paged_attention(
+                qh, k_cache, v_cache, tbl_cp, start + off[0],
+                page_size=page_size,
+                qlen=jnp.clip(qlen - off[0], 0, cws),
+                pos_offset=base_cp, return_lse=True,
+                interpret=attn_interpret)
+            bo = bo.astype(jnp.float32)
+        else:
+            bo, blse = _block_attn_xla(qh, k_view, v_view, qph, kv_pos_cp,
+                                       hd ** -0.5)
+        lse_new = jnp.logaddexp(lse, blse)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + bo * jnp.exp(blse - lse_new)[..., None])
+        lse = lse_new
+        if hop < cp - 1:
+            qh, qph, o, lse, off = [ring_permute(t, "cp")
+                                    for t in (qh, qph, o, lse, off)]
+    # rank r now holds sub-block (r - cp + 1) mod cp fully attended; put
+    # every sub-block back at its chunk offset and sum the disjoint slots
+    full = jnp.zeros((b, h, cw, hd), jnp.float32) + zero
+    full = lax.dynamic_update_slice_in_dim(full, o, off[0], axis=2)
+    return lax.psum(full, "cp")
+
+
 def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
                          chunk: jax.Array, start: jax.Array,
                          qlen: jax.Array, page_tbl: jax.Array,
@@ -444,7 +591,7 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
                          page_size: int, cos_t, sin_t, dtype,
                          all_logits: bool = False,
                          attn_impl: str = "gather",
-                         attn_interpret: bool = False):
+                         attn_interpret: bool = False, cp: int = 1):
     """One CHUNK of an incremental prefill: process `chunk` (b, cw) tokens
     occupying absolute positions start..start+qlen-1 (columns >= qlen are
     pad), write their K/V into the pages `dst_page`/`dst_off` (b, cw) map
@@ -466,7 +613,24 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
     draft positions in this one dispatch, each row starting at its own
     cursor (`start` is per-row), with page growth/COW already resolved by
     the host through the same `dst_page`/`dst_off` maps a prefill chunk
-    uses."""
+    uses.
+
+    `cp > 1` (ISSUE 18): the pool is page-sharded over 'cp' and the chunk's
+    QUERIES ring around the cp axis instead of the pages. Every rank runs
+    the full-chunk qkv/norm/MLP math replicated (no collectives — the
+    residual stream stays cp-invariant), writes only the K/V of chunk
+    columns whose destination pages it owns (the rest aim at the local
+    scratch), then splits the chunk into cp sub-blocks of cw/cp queries:
+    rank r starts with sub-block r, attends it against its LOCAL pages
+    (online-softmax partial + lse), and collective-permutes the carry
+    (queries, positions, partial out, lse, offset) one rank forward, cp
+    hops total. Each hop's attend covers cw/cp queries x T/cp keys, so the
+    per-rank attend FLOPs are 1/cp of the dense chunk attend — the
+    long-prompt full-mesh-FLOPs win. The hop merge is the same logaddexp
+    recurrence ring_attention uses; a final position-scatter + psum
+    reassembles the full (b, h, cw, hd) output replicated, bit-for-bit the
+    single-pool softmax up to float reassociation. Requires cw % cp == 0
+    (the engine rounds chunk widths up to a cp multiple)."""
     b, cw = chunk.shape
     mp = page_tbl.shape[1]
     buf_len = mp * page_size
@@ -479,6 +643,17 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
     # everything later (incl. garbage pages) masks to exact-zero weight
     visible = (jnp.arange(buf_len)[None, None, :]
                <= pos[:, :, None])[:, None, None, :, :]  # (b,1,1,cw,T)
+    if cp > 1:
+        if cw % cp:
+            raise ValueError(f"cp prefill needs chunk width {cw} divisible "
+                             f"by cp={cp}")
+        cws = cw // cp
+        tbl_cp, base_cp, to_local = _cp_pool_view(pool_k, page_tbl,
+                                                  page_size, cp)
+        dst_page = to_local(dst_page)       # non-owned columns -> scratch
+        t_cp = tbl_cp.shape[1] * page_size
+        kv_pos_cp = jnp.broadcast_to(
+            base_cp + jnp.arange(t_cp, dtype=jnp.int32), (b, t_cp))
 
     def write_cache(cache, z):
         # z: (b, kvh, cw, hd) -> scatter token i of row r to
@@ -496,6 +671,12 @@ def _paged_prefill_chunk(model: Transformer, params: Params, pool_k, pool_v,
             q, k = apply_rotary(q, k, cos, sin)
         k_cache = write_cache(k_cache, k)
         v_cache = write_cache(v_cache, v)
+        if cp > 1:
+            o = _cp_ring_attend(q, k_cache, v_cache, tbl_cp, base_cp,
+                                kv_pos_cp, start, qlen, pos, page_size,
+                                cp, dtype, attn_impl, attn_interpret)
+            x = _finish_block(model, lp, x, o.astype(dtype), dtype)
+            return x, (k_cache, v_cache)
         if attn_impl == "pallas":
             # the chunk's own K/V are in the pool (writes above), so the
             # kernel's start+i causality reproduces `visible` exactly;

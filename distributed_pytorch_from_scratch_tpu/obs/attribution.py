@@ -247,6 +247,94 @@ def ring_chunk_bytes(cfg, batch: int, t: int, tp: int) -> Dict[str, float]:
             "total_bytes": cfg.num_layers * 10 * u + 3 * u}
 
 
+def cp_ring_attribution(cfg, batch: int, chunk: int, context: int,
+                        cp: int, chip: str = "v5e",
+                        decode_steps: int = 0,
+                        measured_allreduce_us: Optional[float] = None) -> Dict:
+    """Price the cp-serving wire (ISSUE 18): the chunked-prefill query
+    ring's ppermute hops against the per-hop attend compute they
+    interleave with, plus the two small psum families (chunk reassembly,
+    decode's (out, lse) combine).
+
+    The ring moves the QUERY carry, never page data: per hop each rank
+    rotates its (b, h, chunk/cp, hd) query sub-block (compute dtype), the
+    f32 (o, lse) accumulators and two int32 position fields to its
+    neighbour, then attends the arrived queries against its LOCAL pool
+    slab (~context/cp keys). The schedule is profitable while
+    `per_hop.wire_ms` < `per_hop.attend_ms` — the ratio this report
+    carries — because at steady state each hop's rotation hides under the
+    next hop's attend (classic ring-attention overlap); the reassembling
+    psum and the decode combine are latency-bound small collectives
+    either way, priced fully exposed.
+
+    Prefill records price ONE chunk dispatch x num_layers (the layer
+    stack is a scan — multiply by ceil(context/chunk) dispatches for a
+    full prompt); `decode_steps` > 0 additionally prices that many
+    (out, lse) combines."""
+    cp = max(1, cp)
+    bw, lat = calibrate_ici(chip, cp,
+                            measured_allreduce_us if cp > 1 else None)
+    peak_flops, _ = CHIP_SPECS.get(chip, CHIP_SPECS["v5e"])
+    A = 2 if "bf16" in str(cfg.compute_dtype) or "bfloat16" in str(
+        cfg.compute_dtype) else 4
+    L, h, hd = cfg.num_layers, cfg.num_heads, cfg.head_dim
+    cws = max(1, chunk // cp)  # per-rank query sub-block width
+    # per-hop carry: compute-dtype query sub-block + f32 (o, lse)
+    # accumulators + int32 positions/offset
+    hop_bytes = (batch * h * cws * hd * (A + 4)   # qh + o
+                 + batch * h * cws * 4            # lse
+                 + batch * cws * 4 + 4)           # qph + off
+    # per-hop attend: cws queries vs the local slab, qk + av matmuls
+    attend_flops = 4 * batch * h * cws * max(1, context // cp) * hd
+    hop_ms = (hop_bytes / bw + lat) * 1e3
+    attend_ms = attend_flops / peak_flops * 1e3
+
+    records = []
+
+    def add(name, kind, count, nbytes, hops, budget_ms, note=""):
+        total = count * (nbytes / bw + hops * lat) * 1e3
+        hidden = min(total, budget_ms) if budget_ms > 0 else 0.0
+        records.append({
+            "name": name, "kind": kind, "count": count,
+            "bytes_each": nbytes, "serialized_ms": total,
+            "hidden_ms": hidden, "exposed_ms": total - hidden, "note": note})
+
+    if cp > 1:
+        ratio = hop_ms / attend_ms if attend_ms > 0 else float("inf")
+        add("cp prefill query ring", "collective-permute",
+            L * (cp - 1), hop_bytes, 1, L * (cp - 1) * attend_ms,
+            f"per-hop carry {hop_bytes / 1e3:.1f} kB vs "
+            f"{attend_flops / 1e9:.3f} GFLOP attend "
+            f"(wire/compute {ratio:.2f}): hops hide under the next "
+            f"hop's attend while the ratio stays < 1")
+        add("cp prefill chunk reassembly", "all-reduce", L,
+            2 * (cp - 1) / cp * batch * h * chunk * hd * 4,
+            2 * (cp - 1), 0.0,
+            "psum of the rotated (out) sub-blocks back into chunk order; "
+            "small and latency-bound")
+        if decode_steps > 0:
+            add("cp decode (out, lse) combine", "all-reduce",
+                decode_steps * L,
+                2 * (cp - 1) / cp * (batch * h * hd * 4 + 2 * batch * h * 4),
+                2 * (cp - 1), 0.0,
+                "per-step psums of the per-rank partial output and softmax "
+                "weights; pure latency")
+
+    total = sum(r["serialized_ms"] for r in records)
+    hidden = sum(r["hidden_ms"] for r in records)
+    return {"records": records,
+            "comm_total_ms": total,
+            "comm_hidden_ms": hidden,
+            "comm_exposed_ms": total - hidden,
+            "per_hop": {"wire_bytes": int(hop_bytes), "wire_ms": hop_ms,
+                        "attend_flops": int(attend_flops),
+                        "attend_ms": attend_ms,
+                        "wire_to_compute": (hop_ms / attend_ms
+                                            if attend_ms > 0 else None)},
+            "config": {"cp": cp, "chunk": chunk, "context": context,
+                       "decode_steps": decode_steps, "chip": chip}}
+
+
 def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
                      tp_overlap: str = "off", dp: int = 1,
                      dp_bucket_mb: float = 0.0, dp_reduce_dtype: str = "f32",
@@ -254,7 +342,9 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
                      remat: str = "dots",
                      measured_allreduce_us: Optional[float] = None,
                      phase_ms: Optional[Dict[str, float]] = None,
-                     zero_stage: int = 0) -> Dict:
+                     zero_stage: int = 0, cp: int = 1,
+                     cp_prefill_chunk: int = 0,
+                     cp_context: int = 0) -> Dict:
     """Per-collective comm attribution with an overlap model: how many ms
     of ICI time the step spends, and how much of it HIDES under the matmul
     each collective is (or could be) fused with.
@@ -412,6 +502,16 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
             add("DP grad reduce", "all-reduce", 1, nbytes, 2 * (dp - 1),
                 budget, note)
 
+    if cp > 1 and cp_prefill_chunk > 0:
+        # serving-side cp ring (ISSUE 18): priced by cp_ring_attribution
+        # and folded into the same record table so one report covers the
+        # whole wire
+        ring = cp_ring_attribution(
+            cfg, batch, cp_prefill_chunk,
+            max(cp_context, cp_prefill_chunk), cp, chip=chip,
+            measured_allreduce_us=measured_allreduce_us)
+        records.extend(ring["records"])
+
     total = sum(r["serialized_ms"] for r in records)
     hidden = sum(r["hidden_ms"] for r in records)
     return {"records": records,
@@ -432,7 +532,11 @@ def comm_attribution(cfg, batch: int, t: int, tp: int = 1, sp: bool = False,
                        "wire_dtype": (dp_reduce_dtype if zero_stage < 3
                                       else "f32"),
                        "tp_wire_dtype": ("int8" if tp_overlap == "ring_q"
-                                         else "bf16")}}
+                                         else "bf16"),
+                       # serving-side cp ring inputs (ISSUE 18); 1/0 when
+                       # the report prices a pure training step
+                       "cp": cp, "cp_prefill_chunk": cp_prefill_chunk,
+                       "cp_context": cp_context}}
 
 
 def attribution(cfg, batch: int, t: int, remat: str = "dots", spd: int = 8,
@@ -621,7 +725,8 @@ def format_attribution(report: Dict,
 def paged_decode_hbm_bytes(cfg, slots: int, max_pages: int, page_size: int,
                            kv_dtype=None, paged_attn: str = "gather",
                            decode_weight_dtype=None,
-                           live_tokens: Optional[int] = None) -> Dict:
+                           live_tokens: Optional[int] = None,
+                           cp: int = 1) -> Dict:
     """Analytic HBM bytes ONE paged decode dispatch moves, itemised so the
     gather-vs-pallas A/B can assert the win instead of claiming it.
 
@@ -643,12 +748,19 @@ def paged_decode_hbm_bytes(cfg, slots: int, max_pages: int, page_size: int,
       rounded) instead of the dense span.
 
     Returns {weight_bytes, kv_pool_read_bytes, gather_copy_bytes,
-    total_bytes, paged_attn}: `total = weight + pool_read + gather_copy`,
-    so `total(gather) - total(pallas)` at equal live context is the
-    gather-copy elimination plus the dead-page skip."""
+    total_bytes, paged_attn, cp}: `total = weight + pool_read +
+    gather_copy`, so `total(gather) - total(pallas)` at equal live
+    context is the gather-copy elimination plus the dead-page skip.
+
+    `cp` > 1 (ISSUE 18) reports PER-CHIP bytes: each cp rank's page-table
+    view spans only its max_pages/cp slab columns, so the dense span (and
+    the live context a pallas read walks) divides by cp — the ~1/cp
+    per-chip KV traffic the cp shard exists to buy. Weights replicate
+    over cp, so `weight_bytes` does not divide."""
     if paged_attn not in ("gather", "pallas"):
         raise ValueError(f"paged_attn must be 'gather'/'pallas', got "
                          f"{paged_attn!r}")
+    cp = max(1, cp)
     L, kvh, hd = cfg.num_layers, cfg.kv_heads, cfg.head_dim
     compute_itemsize = 2 if "bf16" in str(cfg.compute_dtype) or (
         "bfloat16" in str(cfg.compute_dtype)) else 4
@@ -659,13 +771,15 @@ def paged_decode_hbm_bytes(cfg, slots: int, max_pages: int, page_size: int,
     else:
         stored_per_tok = 2 * L * kvh * hd * compute_itemsize
     view_per_tok = 2 * L * kvh * hd * compute_itemsize  # dequantized view
-    dense_span = slots * max_pages * page_size
+    dense_span = slots * (max_pages // cp) * page_size
     if paged_attn == "gather" or live_tokens is None:
         read_span = dense_span
     else:
-        # block-granular skip: live context rounds up to whole pages
+        # block-granular skip: each rank's ~1/cp share of the live
+        # context rounds up to whole local pages
+        live_local = -(-int(live_tokens) // cp)
         read_span = min(dense_span,
-                        -(-int(live_tokens) // page_size) * page_size)
+                        -(-live_local // page_size) * page_size)
     weight_itemsize = 1 if decode_weight_dtype in ("int8", "s8") else (
         compute_itemsize)
     weight_bytes = cfg.num_params() * weight_itemsize
@@ -674,6 +788,7 @@ def paged_decode_hbm_bytes(cfg, slots: int, max_pages: int, page_size: int,
         else 0
     return {
         "paged_attn": paged_attn,
+        "cp": cp,
         "weight_bytes": int(weight_bytes),
         "kv_pool_read_bytes": int(pool_read),
         "gather_copy_bytes": int(gather_copy),
@@ -689,7 +804,8 @@ def expected_collectives(tp: int = 1, sp: bool = False,
                          dp_reduce_dtype: str = "f32",
                          zero_stage: int = 0,
                          serving: bool = False,
-                         kind: Optional[str] = None) -> Dict:
+                         kind: Optional[str] = None,
+                         cp: int = 1) -> Dict:
     """The schedule `comm_attribution` prices, as a CHECKABLE contract
     over a compiled program's collective inventory: (mesh axis, HLO op)
     pairs that must be present (`require`), may be present (`allow`), and
@@ -827,6 +943,36 @@ def expected_collectives(tp: int = 1, sp: bool = False,
         allow[("tp", "reduce-scatter")] = "XLA-derived scatters"
         allow[("tp", "all-to-all")] = "XLA-derived rewrites"
         allow[("tp", "collective-permute")] = "XLA-derived rotations"
+
+    if serving and cp > 1:
+        # cp-sharded paged serving (ISSUE 18): decode combines the
+        # per-rank partial (out, lse) with small cp psums; chunked
+        # prefill (and its speculative-verify twin) ADDITIONALLY rings
+        # the query carry around cp before one reassembling psum. Page
+        # DATA never crosses the wire — the byte-threshold canary
+        # (analysis/contracts.check_cp_no_page_gather) forbids
+        # pool-sized cp gathers the way the ZeRO-3 rule forbids
+        # whole-tree dp gathers; this inventory only admits small
+        # XLA-derived gathers (psum rewrites, sampler plumbing).
+        require[("cp", "all-reduce")] = {
+            "dtypes": wide,
+            "note": "the (out, lse) combine psums (decode) / the chunk "
+                    "reassembly psum (prefill ring)"}
+        if kind in ("prefill_chunk", "spec_verify"):
+            require[("cp", "collective-permute")] = {
+                "dtypes": wide | {"s32", "u32"},
+                "note": "the prefill query ring: per-hop rotation of the "
+                        "(qh, qph, o, lse, off) carry around cp"}
+        else:
+            allow[("cp", "collective-permute")] = (
+                "XLA-derived rotations (decode itself combines with "
+                "psums only)")
+        allow[("cp", "all-gather")] = (
+            "small XLA-derived gathers (psum rewrites / sampler "
+            "plumbing); pool-sized page gathers are the byte-threshold "
+            "canary's job, not this inventory's")
+        allow[("cp", "reduce-scatter")] = "XLA-derived scatters"
+        allow[("cp", "all-to-all")] = "XLA-derived rewrites"
 
     return {"require": require, "allow": allow, "forbid": forbid}
 

@@ -80,6 +80,10 @@ from .flash_attention import MASK, _out_struct
 
 IMPLS = ("gather", "pallas")
 
+# dead-row lse sentinel — matches ops/ring_attention._BIG_NEG so a cp
+# shard with no visible K/V for a row combines with exactly zero weight
+_LSE_DEAD = -1e30
+
 
 def _interpret_backend() -> bool:
     return jax.default_backend() != "tpu"
@@ -90,17 +94,20 @@ def _interpret_backend() -> bool:
 
 def _paged_kernel(tbl_ref, start_ref, vmax_ref, base_ref, q_ref, *refs,
                   scale: float, ps: int, n_pages: int, cw: int,
-                  num_blocks: int, quantized: bool, out_dtype):
+                  num_blocks: int, quantized: bool, out_dtype,
+                  want_lse: bool = False):
     """One (row, kv_head) pair's walk over `n_pages` pages per grid step.
 
-    refs: n_pages x (k[,k_scale], v[,v_scale]) page blocks, then o_ref,
-    then the online-softmax scratch (acc, m, l). Scalar operands:
-    page table (unused here — consumed by the index maps), per-row chunk
-    start, per-row max visible position, global position base."""
+    refs: n_pages x (k[,k_scale], v[,v_scale]) page blocks, then o_ref
+    (and lse_ref when `want_lse`), then the online-softmax scratch
+    (acc, m, l). Scalar operands: page table (unused here — consumed by
+    the index maps), per-row chunk start, per-row max visible position,
+    global position base."""
     per = 4 if quantized else 2
     kv_refs = refs[:per * n_pages]
     o_ref = refs[per * n_pages]
-    acc_ref, m_ref, l_ref = refs[per * n_pages + 1:]
+    lse_ref = refs[per * n_pages + 1] if want_lse else None
+    acc_ref, m_ref, l_ref = refs[per * n_pages + (2 if want_lse else 1):]
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -166,12 +173,21 @@ def _paged_kernel(tbl_ref, start_ref, vmax_ref, base_ref, q_ref, *refs,
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)  # rows with no visible kv
         o_ref[0, 0] = (acc_ref[:] / l_safe).astype(out_dtype)
+        if want_lse:
+            # logsumexp of the row's visible scores — the cp combine's
+            # currency (ring_attention's (o, lse) contract): dead rows
+            # (nothing visible on THIS pool shard) emit the same big-neg
+            # sentinel the ring's block math uses, so exp(lse - max)
+            # underflows them to an exact-zero combine weight
+            lse_ref[0, 0] = jnp.where(l == 0.0, _LSE_DEAD,
+                                      m_ref[:] + jnp.log(l_safe))
 
 
 def paged_attention(q: jax.Array, k_pool, v_pool, page_tbl: jax.Array,
                     start, *, page_size: int, qlen=None,
                     pages_per_block: Optional[int] = None,
-                    pos_offset=0, interpret: bool = False) -> jax.Array:
+                    pos_offset=0, return_lse: bool = False,
+                    interpret: bool = False):
     """Attend `q` over the paged K/V pool through the page table, in place.
 
     q: (b, heads, cw, hd) — cw = 1 is the decode step, cw > 1 a prefill
@@ -187,6 +203,11 @@ def paged_attention(q: jax.Array, k_pool, v_pool, page_tbl: jax.Array,
     columns cost nothing). pos_offset: the global position of the LOCAL
     pool's first page slot — 0 for a whole pool; a cp shard passes its
     chunk offset (cp-shardable by construction, ROADMAP item 3).
+    return_lse=True additionally returns the per-query logsumexp of the
+    visible scores, (b, heads, cw) f32 with dead rows at -1e30 — the
+    (out, lse) pair a cp shard's partial result combines through (ISSUE
+    18); the default single-output shape is unchanged for every existing
+    caller.
 
     Value contract: identical math to `_gather_page_view` + the dense
     attend block (f32 scores, softmax over visible positions, f32
@@ -252,23 +273,33 @@ def paged_attention(q: jax.Array, k_pool, v_pool, page_tbl: jax.Array,
                          pl.BlockSpec((1, 1, ps, hd), page_ix)]
             ops += [k_pool, v_pool]
 
+    out_block = pl.BlockSpec((1, 1, R, hd),
+                             lambda bi, hi, j, *s: (bi, hi, 0, 0))
+    out_shape = _out_struct((b, kvh, R, hd), q.dtype, q)
+    out_specs = out_block
+    if return_lse:
+        lse_block = pl.BlockSpec((1, 1, R, 1),
+                                 lambda bi, hi, j, *s: (bi, hi, 0, 0))
+        out_shape = (out_shape,
+                     _out_struct((b, kvh, R, 1), jnp.float32, q))
+        out_specs = (out_block, lse_block)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(b, kvh, num_blocks),
         in_specs=[q_spec] + kv_specs,
-        out_specs=pl.BlockSpec((1, 1, R, hd),
-                               lambda bi, hi, j, *s: (bi, hi, 0, 0)),
+        out_specs=out_specs,
         scratch_shapes=[pltpu.VMEM((R, hd), jnp.float32),
                         pltpu.VMEM((R, 1), jnp.float32),
                         pltpu.VMEM((R, 1), jnp.float32)])
     kernel = functools.partial(
         _paged_kernel, scale=1.0 / math.sqrt(hd), ps=ps, n_pages=N, cw=cw,
-        num_blocks=num_blocks, quantized=quantized, out_dtype=q.dtype)
+        num_blocks=num_blocks, quantized=quantized, out_dtype=q.dtype,
+        want_lse=return_lse)
     # causal per-row work: each row reads ~its live context once
     flops = 4 * b * h * cw * mp * ps * hd
-    o = pl.pallas_call(
+    out = pl.pallas_call(
         kernel, grid_spec=grid_spec,
-        out_shape=_out_struct((b, kvh, R, hd), q.dtype, q),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
@@ -278,7 +309,12 @@ def paged_attention(q: jax.Array, k_pool, v_pool, page_tbl: jax.Array,
             transcendentals=b * h * cw * mp * ps),
         interpret=interpret,
     )(page_tbl, start, vmax, base, qr, *ops)
-    return o.reshape(b, kvh, g, cw, hd).reshape(b, h, cw, hd)
+    if return_lse:
+        o, lse = out
+        o = o.reshape(b, kvh, g, cw, hd).reshape(b, h, cw, hd)
+        lse = lse.reshape(b, kvh, g, cw).reshape(b, h, cw)
+        return o, lse
+    return out.reshape(b, kvh, g, cw, hd).reshape(b, h, cw, hd)
 
 
 # ------------------------------------------------- impl resolution / gate

@@ -229,10 +229,10 @@ class ContinuousBatchingEngine:
                  clock=time.monotonic):
         if getattr(model, "cp_size", 1) > 1:
             raise ValueError(
-                "the serving engine decodes on the cp=1 path (per-slot "
-                "caches are replicated over cp); long-context cp prefill "
-                "stays with models/decode.GreedyDecoder — rebuild the "
-                f"model with cp_size=1 (got {model.cp_size})")
+                "the slot engine's per-slot caches are replicated over cp; "
+                "long-context cp serving is the PAGED engine's job "
+                f"(--paged with --cp {model.cp_size}, ISSUE 18) — use "
+                "PagedEngine, or rebuild the model at cp=1")
         cap = getattr(model, "max_decode_positions", None)
         if cap is not None and buf_len > cap:
             raise ValueError(
@@ -614,12 +614,6 @@ class PagedEngine:
                  tracer=None, writer=None, request_tracer=None,
                  flight=None, telemetry=None, duty_profiler=None,
                  controller=None, clock=time.monotonic):
-        if getattr(model, "cp_size", 1) > 1:
-            raise ValueError(
-                "the serving engine decodes on the cp=1 path (per-slot "
-                "caches are replicated over cp); long-context cp prefill "
-                "stays with models/decode.GreedyDecoder — rebuild the "
-                f"model with cp_size=1 (got {model.cp_size})")
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if page_size < 1:
@@ -627,11 +621,19 @@ class PagedEngine:
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, got "
                              f"{prefill_chunk}")
+        # cp-sharded serving (ISSUE 18): the pool's page dim shards over
+        # the 'cp' mesh axis; the host keeps ONE global page table and
+        # rank-global accounting, and the compiled programs translate to
+        # local slabs per rank. Page-table column j belongs to cp rank
+        # j // (max_pages/cp), so max_pages rounds up to a cp multiple.
+        self.cp = max(1, int(getattr(model, "cp_size", 1)))
         # the logical per-request buffer rounds UP to whole pages; the
         # dense gathered view is max_pages * page_size wide
         self.page_size = page_size
-        self.max_pages = -(-buf_len // page_size)
+        pages = -(-buf_len // page_size)
+        self.max_pages = self.cp * -(-pages // self.cp)
         self.buf_len = self.max_pages * page_size
+        self._mpp = self.max_pages // self.cp   # page-table cols per cp rank
         cap = getattr(model, "max_decode_positions", None)
         if cap is not None and self.buf_len > cap:
             raise ValueError(
@@ -640,6 +642,8 @@ class PagedEngine:
                 f"({cap}); clamp the buffer or retrain with a larger maxlen")
         if not num_pages:
             num_pages = num_slots * self.max_pages  # no oversubscription
+        # the pool splits its pages into equal per-rank slabs (cp=1: one)
+        num_pages = self.cp * -(-num_pages // self.cp)
         self.model = model
         self.mesh = mesh
         self.params = params
@@ -735,7 +739,8 @@ class PagedEngine:
         model, ps, dtype = self.model, self.page_size, self._dtype
         debug = self._debug_host_sampler
         impl, interp = self.paged_attn_impl, self._paged_attn_interpret
-        pspec = self.pool.pspec   # plain POOL_SPEC, or (codes, scales)
+        cp = self.cp
+        pspec = self.pool.pspec   # POOL_SPEC / CP_POOL_SPEC, or (codes, sc)
 
         def shard_fn(params, pool_k, pool_v, tokens, pos, seeds, tbl):
             params = self._deq(params)   # int8 decode weights dequant here
@@ -743,7 +748,7 @@ class PagedEngine:
             pool_k, pool_v, logits = _paged_decode_one(
                 model, params, pool_k, pool_v, tokens, pos, tbl, ps,
                 cos_t, sin_t, dtype, attn_impl=impl,
-                attn_interpret=interp)
+                attn_interpret=interp, cp=cp)
             if debug:
                 return pool_k, pool_v, logits.astype(jnp.float32)
             tok = self._sample(logits, seeds, pos + 1)
@@ -760,6 +765,7 @@ class PagedEngine:
     def _build_chunk(self, cw: int):
         model, ps, dtype = self.model, self.page_size, self._dtype
         impl, interp = self.paged_attn_impl, self._paged_attn_interpret
+        cp = self.cp
         pspec = self.pool.pspec
 
         def shard_fn(params, pool_k, pool_v, chunk, start, qlen, tbl,
@@ -769,7 +775,7 @@ class PagedEngine:
             pool_k, pool_v, logits = _paged_prefill_chunk(
                 model, params, pool_k, pool_v, chunk, start, qlen, tbl,
                 dstp, dsto, ps, cos_t, sin_t, dtype, attn_impl=impl,
-                attn_interpret=interp)
+                attn_interpret=interp, cp=cp)
             tok = self._sample(logits, seeds, start + qlen)
             return pool_k, pool_v, tok
 
@@ -795,6 +801,15 @@ class PagedEngine:
                 f"({len(req.prompt)}+{req.max_new} tokens / page_size "
                 f"{self.page_size}) but the pool has {self.pool.num_pages} "
                 f"— raise --num_pages or lower the budget")
+        # cp>1: ownership is positional (column j -> rank j//mpp), so the
+        # worst case drawn from ONE rank's slab is min(need, mpp) pages
+        if min(need, self._mpp) > self.pool.pages_per_rank:
+            raise ValueError(
+                f"request {req.rid}: needs up to {min(need, self._mpp)} "
+                f"pages from one cp rank's slab ({need} total over cp="
+                f"{self.cp}) but each slab holds "
+                f"{self.pool.pages_per_rank} — raise --num_pages or lower "
+                f"the budget")
         self.scheduler.submit(req)
         if self.rt is not None:
             self.rt.begin(req, ctx=_wire_ctx(req))
@@ -898,7 +913,7 @@ class PagedEngine:
             # sharing, resolved at chunk time, can only reduce it), so a
             # freshly admitted request never instantly deadlocks the pump
             need = -(-min(len(ids), self.prefill_chunk) // self.page_size)
-            if need > self.pool.free_pages:
+            if not self._fits_free(need):
                 if not (overdue and self._preempt_for(req)):
                     break
                 continue
@@ -988,15 +1003,30 @@ class PagedEngine:
         self._free_slots.append(slot)
         return freed
 
-    def _alloc_page(self, needy_slot: int) -> int:
-        """A free page, evicting victims if the pool is dry (never the
-        needy slot itself). Submit-time validation guarantees a sole live
-        request fits, so exhaustion with no victim cannot happen. A
-        PoolExhausted-forced preemption freezes the flight ring: the dump
-        shows the pool/scheduler state that led to the eviction."""
+    def _fits_free(self, need: int) -> bool:
+        """Can `need` pages for page-table columns [0, need) be leased
+        right now? cp=1: one free list. cp>1: the columns split into
+        per-rank spans of `mpp`, and every rank's share must fit its own
+        slab — a pool half-free in aggregate still refuses when rank 0's
+        slab is dry (ownership is positional, pages cannot migrate)."""
+        if self.cp == 1:
+            return need <= self.pool.free_pages
+        for o in range(self.cp):
+            cols = max(0, min(need, (o + 1) * self._mpp) - o * self._mpp)
+            if cols > self.pool.free_pages_of(o):
+                return False
+        return True
+
+    def _alloc_page(self, needy_slot: int, owner: int = 0) -> int:
+        """A free page from cp rank `owner`'s slab (cp=1: the whole pool),
+        evicting victims if the slab is dry (never the needy slot itself).
+        Submit-time validation guarantees a sole live request fits, so
+        exhaustion with no victim cannot happen. A PoolExhausted-forced
+        preemption freezes the flight ring: the dump shows the
+        pool/scheduler state that led to the eviction."""
         while True:
             try:
-                return self.pool.alloc()
+                return self.pool.alloc(owner)
             except PoolExhausted:
                 cands = self._candidates(exclude_slot=needy_slot)
                 if not cands:
@@ -1026,12 +1056,15 @@ class PagedEngine:
         pairs = []
         allocated = 0
         for j in range(lo // ps, -(-hi // ps)):
+            owner = j // self._mpp     # cp rank whose slab backs column j
             pid = int(self._tbl[slot, j])
             if pid == scratch:
-                self._tbl[slot, j] = self._alloc_page(slot)
+                self._tbl[slot, j] = self._alloc_page(slot, owner)
                 allocated += 1
             elif self.pool.refcount[pid] > 1:
-                new = self._alloc_page(slot)
+                # same-column COW: src and dst share the owner, so the
+                # device copy never crosses cp slabs
+                new = self._alloc_page(slot, owner)
                 pairs.append((pid, new))
                 self.pool.unref(pid)
                 self._tbl[slot, j] = new
@@ -1066,6 +1099,9 @@ class PagedEngine:
         s, ids, req = st.s, st.ids, st.req
         leased, cowed = self._ensure_writable(slot, s, s + n)
         cw = _pow2_at_most(n, self.prefill_chunk)
+        # the cp query ring splits the chunk into cp sub-blocks, so the
+        # dispatch width rounds up to a cp multiple (pads are scratch-aimed)
+        cw = self.cp * -(-cw // self.cp)
         buf, dstp, dsto = _chunk_maps(ids, s, n, cw, ps, self.eos_id,
                                       self.pool.scratch_page,
                                       self._tbl[slot])
@@ -1296,6 +1332,9 @@ class PagedEngine:
             "page_size": self.page_size,
             "kv_dtype": self.kv_dtype or "native",
             "paged_attn": self.paged_attn_impl,
+            # -- cp page sharding (ISSUE 18) -----------------------------
+            "cp": self.cp,
+            "pages_per_rank": self.pool.pages_per_rank,
             "num_pages": self.pool.num_pages,
             "pages_in_use": self.pool.pages_in_use,
             "pages_in_use_mean": round(self._pages_used_sum / steps

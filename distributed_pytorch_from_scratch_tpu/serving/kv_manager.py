@@ -45,6 +45,29 @@ POOL_SPEC = P(None, None, "tp", None, None)
 # int8 pools carry a parallel scale array (layers, pages, kv_heads, page)
 # — one f32 per stored head-vector; same 'tp'-on-heads partitioning
 KV_SCALE_SPEC = P(None, None, "tp", None)
+# cp-sharded PAGED pool (ISSUE 18): the page dim ALSO shards, over 'cp' —
+# each cp rank owns a contiguous slab of physical pages (plus its own local
+# scratch page), so per-chip KV bytes shrink ~1/cp at equal context
+CP_POOL_SPEC = P(None, "cp", "tp", None, None)
+CP_KV_SCALE_SPEC = P(None, "cp", "tp", None)
+
+
+def local_page_ids(tbl, ppr: int, axis: str = "cp"):
+    """GLOBAL page ids -> this cp rank's LOCAL pool indices (call inside
+    shard_map over a mesh with a (possibly size-1) `axis`).
+
+    Layout contract (PagedKVPool, cp > 1): rank r's local slab is
+    [pages_per_rank + 1] entries — global pages [r*ppr, (r+1)*ppr) at local
+    [0, ppr), then ONE rank-local scratch page at local index ppr. Any id
+    this rank does not own (another rank's page, or the host's global
+    scratch sentinel `num_pages`) maps to the LOCAL scratch: reads see
+    quarantined garbage that visibility masks to zero weight, writes are
+    quarantined like the cp=1 scratch page. With cp == 1 (ppr == num_pages)
+    the formula is the identity on every valid id — one rule, no branch."""
+    r = jax.lax.axis_index(axis)
+    lo = r * ppr
+    owned = (tbl >= lo) & (tbl < lo + ppr)
+    return jnp.where(owned, tbl - lo, ppr)
 
 
 def kv_token_bytes(cfg, kv_dtype=None) -> int:
@@ -199,31 +222,53 @@ class PagedKVPool:
             raise ValueError(f"kv_dtype must be None/'native'/'int8', got "
                              f"{kv_dtype!r}")
         cfg = model.cfg
+        # cp-sharded pages (ISSUE 18): the model's cp_size picks the pool
+        # layout — each cp rank owns a disjoint contiguous slab of pages
+        # [r*ppr, (r+1)*ppr) PLUS its own local scratch page, so the array
+        # page dim is num_pages + cp and shards evenly over 'cp'. The host
+        # accounting below stays rank-global (ids are global; the device
+        # programs translate with `local_page_ids`); cp == 1 reproduces the
+        # historical num_pages + 1 layout byte for byte.
+        self.cp = max(1, int(getattr(model, "cp_size", 1)))
+        if num_pages % self.cp:
+            raise ValueError(
+                f"num_pages {num_pages} must be divisible by cp "
+                f"{self.cp} (each cp rank owns an equal page slab; the "
+                f"engine rounds up before building the pool)")
         self.num_pages = num_pages
+        self.pages_per_rank = num_pages // self.cp
         self.page_size = page_size
         self.scratch_page = num_pages          # never leased; pad target
         self.flight = flight  # obs.flight.FlightRecorder: pool anomalies
         self.kv_dtype = "int8" if kv_dtype in ("int8", jnp.int8) else None
-        shape = (cfg.num_layers, num_pages + 1, cfg.kv_heads, page_size,
-                 cfg.head_dim)
+        shape = (cfg.num_layers, num_pages + self.cp, cfg.kv_heads,
+                 page_size, cfg.head_dim)
+        pool_spec = CP_POOL_SPEC if self.cp > 1 else POOL_SPEC
+        scale_spec = CP_KV_SCALE_SPEC if self.cp > 1 else KV_SCALE_SPEC
         if self.kv_dtype:
             self.dtype = jnp.int8
-            self.pspec = (POOL_SPEC, KV_SCALE_SPEC)
-            self._sharding = (NamedSharding(mesh, POOL_SPEC),
-                              NamedSharding(mesh, KV_SCALE_SPEC))
+            self.pspec = (pool_spec, scale_spec)
+            self._sharding = (NamedSharding(mesh, pool_spec),
+                              NamedSharding(mesh, scale_spec))
             alloc = jax.jit(
                 lambda: (jnp.zeros(shape, jnp.int8),
                          jnp.ones(shape[:-1], jnp.float32)),
                 out_shardings=self._sharding)
         else:
             self.dtype = resolve_dtype(cfg.compute_dtype)
-            self.pspec = POOL_SPEC
-            self._sharding = NamedSharding(mesh, POOL_SPEC)
+            self.pspec = pool_spec
+            self._sharding = NamedSharding(mesh, pool_spec)
             alloc = jax.jit(lambda: jnp.zeros(shape, self.dtype),
                             out_shardings=self._sharding)
+        self.mesh = mesh
         self.ks = alloc()
         self.vs = alloc()
-        self._free = deque(range(num_pages))
+        # per-OWNER free lists: rank r's slab can only back page-table
+        # columns whose positions rank r attends (engine maps column j to
+        # owner j // (max_pages/cp)); cp == 1 degenerates to one list
+        ppr = self.pages_per_rank
+        self._free = [deque(range(r * ppr, (r + 1) * ppr))
+                      for r in range(self.cp)]
         self.refcount = np.zeros(num_pages, np.int32)
         # content-addressed prefix index (see class docstring)
         self._children = {}     # chain_key -> [(page_id, tokens_tuple)]
@@ -234,21 +279,28 @@ class PagedKVPool:
     # -- page leasing -----------------------------------------------------
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
+
+    def free_pages_of(self, owner: int) -> int:
+        return len(self._free[owner])
 
     @property
     def pages_in_use(self) -> int:
-        return self.num_pages - len(self._free)
+        return self.num_pages - self.free_pages
 
-    def alloc(self) -> int:
-        if not self._free:
+    def alloc(self, owner: int = 0) -> int:
+        """Lease a free page from `owner`'s slab (the cp rank that must
+        physically hold it — column j of a page table belongs to rank
+        j // (max_pages/cp)). cp == 1 has the single slab 0."""
+        if not self._free[owner]:
             if self.flight is not None:
-                self.flight.record("pool_exhausted",
+                self.flight.record("pool_exhausted", owner=owner,
                                    num_pages=self.num_pages)
             raise PoolExhausted(
-                f"page pool exhausted ({self.num_pages} pages leased) — "
-                f"the engine preempts or the scheduler gates admission")
-        page = self._free.popleft()
+                f"page pool exhausted (rank {owner}'s slab of "
+                f"{self.pages_per_rank} pages fully leased) — the engine "
+                f"preempts or the scheduler gates admission")
+        page = self._free[owner].popleft()
         self.refcount[page] = 1
         return page
 
@@ -264,7 +316,7 @@ class PagedKVPool:
         self.refcount[page] -= 1
         if self.refcount[page] == 0:
             self._deregister(page)
-            self._free.append(page)
+            self._free[page // self.pages_per_rank].append(page)
 
     # -- prefix index -----------------------------------------------------
     @staticmethod
@@ -310,15 +362,39 @@ class PagedKVPool:
     # -- copy-on-write ----------------------------------------------------
     def _build_copy(self, m: int):
         sh = self._sharding
+        if self.cp == 1:
+            def fn(pk, pv, src, dst):
+                # dim 1 is the page dim for codes (5-D) and scales (4-D)
+                # alike, so one tree-mapped copy serves both pool layouts
+                cp = lambda a: a.at[:, dst].set(a[:, src])
+                return jax.tree.map(cp, pk), jax.tree.map(cp, pv)
+
+            return jax.jit(fn, donate_argnums=(0, 1),
+                           out_shardings=(sh, sh))
+
+        # cp > 1: translate the GLOBAL ids to each rank's local slab inside
+        # shard_map so the copy stays shard-local and collective-free (a
+        # plain jit over the cp-sharded page dim with dynamic indices would
+        # leave XLA free to materialize cross-rank gathers). COW pairs are
+        # same-owner by construction (`copy_pages` checks), so a rank
+        # either owns both sides (the real copy) or neither (a harmless
+        # scratch self-copy, same as the pow2 pad entries).
+        pspec = self.pspec
 
         def fn(pk, pv, src, dst):
-            # dim 1 is the page dim for codes (5-D) and scales (4-D)
-            # alike, so one tree-mapped copy serves both pool layouts
-            cp = lambda a: a.at[:, dst].set(a[:, src])
-            return jax.tree.map(cp, pk), jax.tree.map(cp, pv)
+            def cp_(a):
+                ppr = a.shape[1] - 1
+                ls = local_page_ids(src, ppr)
+                ld = local_page_ids(dst, ppr)
+                return a.at[:, ld].set(a[:, ls])
 
-        return jax.jit(fn, donate_argnums=(0, 1),
-                       out_shardings=(sh, sh))
+            return jax.tree.map(cp_, pk), jax.tree.map(cp_, pv)
+
+        fn_sm = jax.shard_map(
+            fn, mesh=self.mesh,
+            in_specs=(pspec, pspec, P(None), P(None)),
+            out_specs=(pspec, pspec))
+        return jax.jit(fn_sm, donate_argnums=(0, 1))
 
     def copy_pages(self, pairs) -> None:
         """Materialise private copies: pairs of (src_page, dst_page), one
@@ -327,6 +403,14 @@ class PagedKVPool:
         logarithmic)."""
         if not pairs:
             return
+        if self.cp > 1:
+            ppr = self.pages_per_rank
+            for s, d in pairs:
+                if s // ppr != d // ppr:
+                    raise ValueError(
+                        f"COW pair ({s} -> {d}) crosses cp slabs (owners "
+                        f"{s // ppr} -> {d // ppr}); a page-table column's "
+                        f"replacement must stay with its owning rank")
         m = 1
         while m < len(pairs):
             m *= 2
@@ -342,7 +426,7 @@ class PagedKVPool:
         self.cow_copies += len(pairs)
         if self.flight is not None:
             self.flight.record("cow_copy", pages=len(pairs),
-                               free_pages=len(self._free))
+                               free_pages=self.free_pages)
 
     # -- device-array handoff ---------------------------------------------
     def adopt(self, ks, vs) -> None:

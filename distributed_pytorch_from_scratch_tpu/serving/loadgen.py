@@ -214,7 +214,7 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
     }
     if "kv_util_mean" in stats:        # the paged engine's extra telemetry
         summary.update({k: stats[k] for k in (
-            "kv_dtype", "paged_attn",
+            "kv_dtype", "paged_attn", "cp", "pages_per_rank", "num_pages",
             "kv_util_mean", "kv_fragmentation_mean", "pages_in_use_mean",
             "prefix_hit_rate", "cow_copies", "preemptions", "max_live",
             "max_interleaved_prefill_positions")})
@@ -259,7 +259,8 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
             # staged r9 session (and summarize_run.py) can pull the page
             # economics without parsing the whole summary
             engine.writer.event("paged_kv_stats", **{k: stats[k] for k in (
-                "page_size", "kv_dtype", "num_pages", "pages_in_use_mean",
+                "page_size", "kv_dtype", "cp", "pages_per_rank",
+                "num_pages", "pages_in_use_mean",
                 "kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
                 "prefix_hit_tokens", "cow_copies", "preemptions",
                 "max_live", "max_interleaved_prefill_positions")})

@@ -121,6 +121,15 @@ def get_serve_args(argv=None) -> argparse.Namespace:
                         "slots x ceil(buf_len/page_size), i.e. no "
                         "oversubscription — raise slots past the pool to "
                         "oversubscribe)")
+    g.add_argument("--cp", type=int, default=1,
+                   help="--paged: context-parallel ranks; the page pool "
+                        "shards over the 'cp' mesh axis (each rank owns "
+                        "1/cp of the pages, so per-chip KV bytes shrink "
+                        "~1/cp at equal context), chunked prefill rings "
+                        "the query chunk around cp, and decode combines "
+                        "per-rank partial (out, lse). Greedy output is "
+                        "token-identical to cp=1 (docs/SERVING.md, "
+                        "ISSUE 18). The speculative drafter stays cp=1")
     g.add_argument("--prefill_chunk", type=int, default=128,
                    help="--paged: prefill positions per chunk; a live "
                         "stream's decode never stalls by more than one "
@@ -271,6 +280,8 @@ def get_serve_args(argv=None) -> argparse.Namespace:
     args = p.parse_args(argv)
     if (args.decode_top_k or args.decode_top_p) and not args.temperature:
         p.error("--decode_top_k/--decode_top_p need --temperature > 0")
+    if args.cp < 1:
+        p.error(f"--cp must be >= 1, got {args.cp}")
     # class/tenant mixes and the page budget only matter to the paged
     # engine; a silent no-op would misreport what the run measured
     if not args.paged:
@@ -282,6 +293,10 @@ def get_serve_args(argv=None) -> argparse.Namespace:
         if args.paged_attn != "gather":
             p.error("--paged_attn is a --paged knob (the slot engine has "
                     "no page table to walk)")
+        if args.cp != 1:
+            p.error("--cp is a --paged knob (only the page pool shards "
+                    "over cp; the slot engine replicates its caches — "
+                    "add --paged for long-context cp serving)")
         if args.class_mix:
             p.error("--class_mix needs --paged (the FIFO engine has no "
                     "SLO classes)")
@@ -469,13 +484,13 @@ def serve(args: argparse.Namespace) -> dict:
     else:
         cfg = build_model_config(args, vocab_size)
 
-    mesh = make_mesh(MeshConfig(tp=args.tp_size))
+    mesh = make_mesh(MeshConfig(tp=args.tp_size, cp=args.cp))
     if args.family == "gpt2":
         from ..models.gpt2 import GPT2Transformer
-        model = GPT2Transformer(cfg, tp_size=args.tp_size)
+        model = GPT2Transformer(cfg, tp_size=args.tp_size, cp_size=args.cp)
     else:
         from ..models.transformer import Transformer
-        model = Transformer(cfg, tp_size=args.tp_size)
+        model = Transformer(cfg, tp_size=args.tp_size, cp_size=args.cp)
     params = _load_params(args, model, mesh)
 
     if args.arrival == "replay" and args.replay:
@@ -700,7 +715,7 @@ def serve(args: argparse.Namespace) -> dict:
             "tpot_ms_p50", "tpot_ms_p95", "queue_wait_ms_p50",
             "queue_wait_ms_p95", "prefill_pad_waste_eliminated")},
     }
-    for k in ("kv_dtype", "paged_attn",
+    for k in ("kv_dtype", "paged_attn", "cp", "pages_per_rank", "num_pages",
               "kv_util_mean", "kv_fragmentation_mean", "prefix_hit_rate",
               "cow_copies", "preemptions", "max_live",
               "max_interleaved_prefill_positions", "slo_attainment",

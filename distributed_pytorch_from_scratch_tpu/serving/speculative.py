@@ -122,8 +122,12 @@ class SpeculativeEngine(PagedEngine):
                 f"vocab {model.cfg.vocab_size} — build the drafter preset "
                 f"with the target's vocab_size (serve.py does)")
         if getattr(drafter_model, "cp_size", 1) > 1:
-            raise ValueError("the drafter decodes on the cp=1 path, like "
-                             "the target (serving engine contract)")
+            raise ValueError(
+                "speculative serving shards only the TARGET's pages over "
+                "cp (supported shape: target cp>=1, drafter cp=1) — the "
+                "drafter's pool is small enough to replicate, so build the "
+                f"drafter preset with cp_size=1 (got "
+                f"{drafter_model.cp_size})")
         self.k = int(speculate_k)
         self.drafter_model = drafter_model
         self._dparams = drafter_params
@@ -157,6 +161,9 @@ class SpeculativeEngine(PagedEngine):
                                                    self.kv_dtype)
         self._dtbl = np.full((num_slots, self._d_max_pages),
                              self.dpool.scratch_page, np.int32)
+        # verify dispatch width: k+1 positions, padded up to a cp multiple
+        # for the prefill-chunk query ring (pads aim at scratch, qlen<=k+1)
+        self._vw = self.cp * -(-(self.k + 1) // self.cp)
         self._draft_fn = self._build_draft()
         self._verify_fn = self._build_verify()
         self._dchunk_fns = {}
@@ -241,6 +248,11 @@ class SpeculativeEngine(PagedEngine):
         temperature, top_k, top_p = (self._temperature, self._top_k,
                                      self._top_p)
         cw = k + 1
+        # cp>1: the ring splits the dispatch width into cp sub-blocks, so
+        # the verify window pads up to a cp multiple with scratch-aimed
+        # columns (per-row qlen stays <= k+1; pads are never scored)
+        vw = self._vw
+        eos, cp = self.eos_id, self.cp
 
         def leading(accept, qlen):
             """Per-row count of leading accepted drafts, capped by the
@@ -259,12 +271,16 @@ class SpeculativeEngine(PagedEngine):
             block = jnp.concatenate(
                 [jnp.asarray(tokens, jnp.int32)[:, None],
                  jnp.asarray(draft, jnp.int32)], axis=1)      # (b, cw)
+            b = block.shape[0]
+            if vw > cw:
+                block = jnp.concatenate(
+                    [block, jnp.full((b, vw - cw), eos, jnp.int32)], axis=1)
             pool_k, pool_v, logits = _paged_prefill_chunk(
                 model, params, pool_k, pool_v, block, pos, qlen, tbl,
                 dstp, dsto, ps, cos_t, sin_t, dtype, all_logits=True,
-                attn_impl=impl, attn_interpret=interp)
-            full = _full_vocab_logits(model, logits)          # (b, cw, V)
-            b = block.shape[0]
+                attn_impl=impl, attn_interpret=interp, cp=cp)
+            full = _full_vocab_logits(model, logits)[:, :cw]  # (b, cw, V)
+            block = block[:, :cw]
             if temperature == 0.0:
                 tgt = jnp.argmax(full, axis=-1).astype(jnp.int32)
                 n_acc = leading(block[:, 1:] == tgt[:, :k], qlen)
@@ -480,8 +496,8 @@ class SpeculativeEngine(PagedEngine):
         if not self._slot_req:
             return
         b = self.num_slots
-        dstp = np.full((b, k + 1), self.pool.scratch_page, np.int32)
-        dsto = np.tile(np.arange(k + 1, dtype=np.int32)[None, :] % ps,
+        dstp = np.full((b, self._vw), self.pool.scratch_page, np.int32)
+        dsto = np.tile(np.arange(self._vw, dtype=np.int32)[None, :] % ps,
                        (b, 1))
         qlen = np.zeros(b, np.int32)          # free rows: nothing valid
         for slot in self._slot_req:

@@ -57,11 +57,16 @@ def _forensics():
 LOWER_BETTER_UNITS = ("ms/step", "ms/step (analytic)")
 THROUGHPUT_FIELDS = ("value", "vs_baseline", "paged_vs_slot",
                      "accepted_tokens_per_dispatch")
-LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95")
+# prefill_ms_per_token (ISSUE 18) is the long-context cp serving number:
+# the ring schedule exists to hold it flat-or-better while per-chip KV
+# bytes shrink 1/cp, so a record where it GREW vs the trajectory means
+# the ring (or its chunking) regressed, whatever tokens/s measured
+LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95", "prefill_ms_per_token")
 # analytic decode-dispatch HBM traffic (ISSUE 14): strictly directional —
 # a serving record whose per-step bytes GREW vs the trajectory regressed
 # the decode roofline (e.g. the pallas arm silently fell back to gather,
-# or the gather view grew), whatever tokens/s happened to measure
+# or the gather view grew — at cp>1 these are PER-CHIP bytes, ~1/cp of
+# the cp=1 pool), whatever tokens/s happened to measure
 BYTES_FIELDS = ("decode_hbm_bytes_per_step",)
 # MEASURED attribution (ISSUE 15): when both records carry a
 # measured_vs_analytic reconcile (bench --profile_every / the breakdown

@@ -1,0 +1,311 @@
+"""Long-context cp serving correctness — ISSUE 18.
+
+The paged KV pool shards over the 'cp' mesh axis (each rank owns a
+disjoint slab of physical pages plus one scratch page), chunked prefill
+rings the query chunk around cp over each rank's LOCAL pages, and decode
+attends cp-locally then combines per-rank (out, lse) partials with one
+exact online-softmax merge. None of that may move a token: the anchor
+contract here is GREEDY TOKEN IDENTITY between cp=2 and the cp=1 oracle
+across page sizes, KV storage dtypes, and both attend impls (gather and
+the Pallas kernel in interpreter mode) — sharding changes per-chip BYTES
+(~1/cp at equal context, asserted via pages_per_rank), never tokens.
+
+Plus the cp-specific invariants: COW prefix sharing and preempt-resume
+work across cp shards (ownership is positional, so a resumed request
+re-lands its pages on the same ranks), ring prefill is chunk-boundary
+invariant (including a chunk width the engine must round UP to a cp
+multiple), the slot engine / speculative drafter refuse cp>1 models
+loudly naming the supported shape, and the capacity win the sharding
+exists for: at EQUAL per-chip page bytes, cp=2 admits and completes a
+request whose page demand the cp=1 pool refuses up front.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_pytorch_from_scratch_tpu.config import MeshConfig, ModelConfig
+from distributed_pytorch_from_scratch_tpu.models.decode import GreedyDecoder
+from distributed_pytorch_from_scratch_tpu.models.transformer import Transformer
+from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+from distributed_pytorch_from_scratch_tpu.serving.engine import (
+    ContinuousBatchingEngine, PagedEngine, Request)
+
+CFG = ModelConfig(attn_dim=32, ffn_dim=64, num_heads=8, num_layers=2,
+                  vocab_size=96, maxlen=64)
+BUF = 32
+EOS = 1
+
+PROMPTS = [
+    [0, 5, 17, 33, 60],
+    [0, 95],                        # boundary vocab id
+    [0, 2, 4, 6, 8, 10, 12, 14],    # page-boundary prompt at ps=8
+    [0, 3, 5, 7, 11, 13, 17],
+]
+
+
+def _setup(cp, tp=2, seed=7):
+    """cp x tp mesh + model. Same seed => bit-identical init values at
+    every cp (cp_size changes sharding and lowering, never weights), so
+    a cp=1 build IS the oracle for a cp=2 build."""
+    mesh = make_mesh(MeshConfig(dp=1, cp=cp, tp=tp))
+    model = Transformer(CFG, tp_size=tp, cp_size=cp)
+    params = jax.device_put(model.init(jax.random.key(seed)),
+                            model.shardings(mesh))
+    return mesh, model, params
+
+
+def _assert_drained(eng):
+    """No page leak across the cp slabs: every page back on its owner's
+    free list, refcounts at zero, prefix index empty."""
+    assert eng.pool.free_pages == eng.pool.num_pages, (
+        eng.pool.free_pages, eng.pool.num_pages)
+    assert (eng.pool.refcount == 0).all(), eng.pool.refcount
+    assert not eng.pool._children and not eng.pool._page_keys
+
+
+def _drive(eng, prompts, max_new=8):
+    """Staggered admissions (two live + late arrivals reversed) so the
+    cp decode/prefill programs run INTERLEAVED, not one clean phase."""
+    reqs = [Request(rid=i, prompt=list(p), max_new=max_new)
+            for i, p in enumerate(prompts)]
+    eng.submit(reqs[0])
+    eng.submit(reqs[1])
+    for _ in range(3):
+        eng.step()
+    for r in reversed(reqs[2:]):
+        eng.submit(r)
+    eng.run_to_completion()
+    return {r.rid: r.tokens for r in eng.completed}
+
+
+_MATRIX_SETUPS = {}   # cp -> the one tp=1 (mesh, model, params) build
+_ORACLE = {}          # (ps, kv_dtype) -> cp=1 greedy tokens (gather impl)
+
+
+def _matrix_setup(cp):
+    """The identity matrix runs at tp=1: cp is what's under test here,
+    and cp x tp composition is covered by the other tests in this file
+    (all tp=2). Params are read-only to the engines, so one build per cp
+    serves every combo."""
+    if cp not in _MATRIX_SETUPS:
+        _MATRIX_SETUPS[cp] = _setup(cp, tp=1)
+    return _MATRIX_SETUPS[cp]
+
+
+def _oracle(ps, kv_dtype):
+    """cp=1 oracle tokens, computed ONCE per (ps, kv_dtype) with the
+    gather impl: gather==pallas token identity at cp=1 is already pinned
+    by test_paged_kernel (native and int8 pools), so one oracle serves
+    both impl arms — what's under test is the cp sharding, not the
+    kernel."""
+    key = (ps, kv_dtype)
+    if key not in _ORACLE:
+        mesh1, model1, params1 = _matrix_setup(1)
+        eng = PagedEngine(model1, mesh1, params1, num_slots=2,
+                          buf_len=BUF, eos_id=EOS, page_size=ps,
+                          prefill_chunk=4, kv_dtype=kv_dtype,
+                          paged_attn_impl="gather")
+        _ORACLE[key] = _drive(eng, PROMPTS)
+        _assert_drained(eng)
+    return _ORACLE[key]
+
+
+@pytest.mark.parametrize("ps", [8, 16])
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+@pytest.mark.parametrize("impl", ["gather", "pallas"])
+def test_cp2_token_identical_to_cp1_oracle(ps, kv_dtype, impl):
+    """The tentpole contract: cp=2 greedy == the cp=1 oracle at the SAME
+    page size and KV dtype (pallas runs the real kernel in interpreter
+    mode — cp hands it pos_offset per shard and merges lse). The int8
+    arms compare int8-to-int8: quantisation moves tokens vs native,
+    sharding must not move them vs cp=1. The native-gather arm
+    additionally anchors to the fused GreedyDecoder."""
+    oracle = _oracle(ps, kv_dtype)
+
+    mesh2, model2, params2 = _matrix_setup(2)
+    eng = PagedEngine(model2, mesh2, params2, num_slots=2, buf_len=BUF,
+                      eos_id=EOS, page_size=ps, prefill_chunk=4,
+                      kv_dtype=kv_dtype, paged_attn_impl=impl,
+                      paged_attn_interpret=impl == "pallas")
+    assert eng.paged_attn_impl == impl   # interpret opt-in: no fallback
+    assert eng.cp == 2 and eng.pool.cp == 2
+    # the bytes claim behind the whole exercise: each rank's slab is
+    # 1/cp of the real pages (plus its one scratch page)
+    assert eng.pool.pages_per_rank == eng.pool.num_pages // 2
+    got = _drive(eng, PROMPTS)
+
+    assert len(got) == len(PROMPTS)
+    for i in range(len(PROMPTS)):
+        assert got[i] == oracle[i], (ps, kv_dtype, impl, i,
+                                     got[i], oracle[i])
+    if kv_dtype is None and impl == "gather":
+        mesh1, model1, params1 = _matrix_setup(1)
+        dec = GreedyDecoder(model1, mesh1, BUF)
+        for i, p in enumerate(PROMPTS):
+            ref = dec.decode(params1, p, EOS, max_total_len=len(p) + 8)
+            assert got[i] == ref, (i, got[i], ref)
+    _assert_drained(eng)
+
+
+def test_cp_cow_shared_prefix_identity_and_drain():
+    """COW prefix sharing across cp shards: ownership is positional
+    (page-table column j -> rank j // mpp), so three requests sharing an
+    18-token prefix (two full ps=8 pages + a partial tail) share pages
+    that live on BOTH ranks' slabs, and the copy-on-write of the shared
+    tail pairs source and destination on the SAME owner. Outputs must
+    equal unshared solo decodes; the cache must actually hit; at least
+    one COW copy must happen; everything drains."""
+    mesh1, model1, params1 = _setup(1, seed=3)
+    dec = GreedyDecoder(model1, mesh1, BUF)
+    pre = [0, 7, 3, 9, 22, 41, 5, 13, 28, 31, 6, 44, 2, 19, 55, 8, 60, 12]
+    prompts = [pre + [70], pre + [80], pre + [90, 33]]
+    refs = [dec.decode(params1, p, EOS, max_total_len=len(p) + 8)
+            for p in prompts]
+
+    mesh, model, params = _setup(2, seed=3)
+    eng = PagedEngine(model, mesh, params, num_slots=3, buf_len=BUF,
+                      eos_id=EOS, page_size=8, prefill_chunk=32)
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=i, prompt=p, max_new=8))
+    eng.run_to_completion()
+    got = {r.rid: r.tokens for r in eng.completed}
+    for i, ref in enumerate(refs):
+        assert got[i] == ref, (i, got[i], ref)
+    st = eng.stats()
+    assert st["prefix_hit_tokens"] >= 16, st   # both full shared pages
+    assert st["cow_copies"] >= 1, st
+    assert st["cp"] == 2 and st["pages_per_rank"] == st["num_pages"] // 2
+    _assert_drained(eng)
+
+
+def test_cp_preempt_resume_token_identity():
+    """Decode-time pool exhaustion at cp=2: three growing requests
+    through slabs too small for their combined growth must preempt a
+    victim (its pages freed on their OWNING ranks), then resume it
+    through the cp ring-prefill path — token-identical to uninterrupted
+    solo decodes."""
+    mesh1, model1, params1 = _setup(1, seed=3)
+    dec = GreedyDecoder(model1, mesh1, BUF)
+    prompts = [[0, 5, 9, 60, 2, 8, 33], [0, 11, 4, 7, 21, 35, 2],
+               [0, 44, 17, 8, 52, 3, 71]]
+    refs = [dec.decode(params1, p, EOS, max_total_len=len(p) + 12)
+            for p in prompts]
+
+    mesh, model, params = _setup(2, seed=3)
+    eng = PagedEngine(model, mesh, params, num_slots=3, buf_len=BUF,
+                      eos_id=EOS, page_size=8, num_pages=4,
+                      prefill_chunk=8)
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=i, prompt=p, max_new=12))
+    eng.run_to_completion()
+    got = {r.rid: r.tokens for r in eng.completed}
+    for i, ref in enumerate(refs):
+        assert got[i] == ref, (i, got[i], ref)
+    assert eng.stats()["preemptions"] >= 1
+    _assert_drained(eng)
+
+
+def test_cp_ring_prefill_chunk_boundary_invariance():
+    """The query ring must be chunk-boundary invariant: a 40-token prompt
+    prefilled at chunk 4, at chunk 5 (NOT a cp multiple — the engine must
+    round the compiled width up to 6 and mask the pad), and at chunk 64
+    (whole prompt in one ring) all produce the cp=1 oracle's tokens, with
+    a short live stream decoding throughout so ring hops interleave with
+    cp-combined decode steps."""
+    buf = 48
+    rng = np.random.default_rng(5)
+    long = [0] + [int(t) for t in rng.integers(3, CFG.vocab_size, size=39)]
+    short = [0, 5, 9]
+
+    mesh1, model1, params1 = _setup(1)
+    dec = GreedyDecoder(model1, mesh1, buf)
+    ref_long = dec.decode(params1, long, EOS, max_total_len=len(long) + 5)
+    ref_short = dec.decode(params1, short, EOS,
+                           max_total_len=len(short) + 6)
+
+    mesh, model, params = _setup(2)
+    for chunk in (4, 5, 64):
+        eng = PagedEngine(model, mesh, params, num_slots=2, buf_len=buf,
+                          eos_id=EOS, page_size=8, prefill_chunk=chunk)
+        eng.submit(Request(rid=0, prompt=short, max_new=6))
+        eng.step()
+        eng.submit(Request(rid=1, prompt=long, max_new=5))
+        eng.run_to_completion()
+        got = {r.rid: r.tokens for r in eng.completed}
+        assert got[0] == ref_short, (chunk, got[0], ref_short)
+        assert got[1] == ref_long, (chunk, got[1], ref_long)
+        _assert_drained(eng)
+
+
+def test_cp2_equal_per_chip_hbm_admits_what_cp1_refuses():
+    """The capacity win the sharding exists for: at EQUAL per-chip page
+    bytes (cp=1 pool of 4 pages vs cp=2 pool of 8 = 4 per rank), a
+    5-page request is refused up front by cp=1 ('needs up to N pages')
+    but admitted AND completed token-identically by cp=2 — the long
+    context fits because each chip holds 1/cp of it."""
+    buf = 48
+    rng = np.random.default_rng(9)
+    prompt = [0] + [int(t) for t in
+                    rng.integers(3, CFG.vocab_size, size=34)]
+    req = lambda: Request(rid=0, prompt=list(prompt), max_new=5)
+    # need = ceil((35 + 5) / 8) = 5 pages > the cp=1 pool's 4
+    mesh1, model1, params1 = _setup(1)
+    small = PagedEngine(model1, mesh1, params1, num_slots=1, buf_len=buf,
+                        eos_id=EOS, page_size=8, num_pages=4,
+                        prefill_chunk=8)
+    with pytest.raises(ValueError, match="pages"):
+        small.submit(req())
+
+    mesh, model, params = _setup(2)
+    eng = PagedEngine(model, mesh, params, num_slots=1, buf_len=buf,
+                      eos_id=EOS, page_size=8, num_pages=8,
+                      prefill_chunk=8)
+    assert eng.pool.pages_per_rank == 4   # = the cp=1 pool: equal HBM
+    eng.submit(req())
+    eng.run_to_completion()
+    ref = GreedyDecoder(model1, mesh1, buf).decode(
+        params1, prompt, EOS, max_total_len=len(prompt) + 5)
+    assert eng.completed[0].tokens == ref
+    _assert_drained(eng)
+
+
+def test_cp_record_fields_flow_through_loadgen():
+    """serve.py's record copies cp/pages_per_rank/num_pages from the
+    loadgen summary ('if k in summary' — a key loadgen forgets to lift
+    from engine.stats() silently un-records the resolved cp), so pin
+    the lift here at cp=2."""
+    from distributed_pytorch_from_scratch_tpu.serving.loadgen import (
+        run_loadgen)
+    mesh, model, params = _matrix_setup(2)
+    eng = PagedEngine(model, mesh, params, num_slots=2, buf_len=BUF,
+                      eos_id=EOS, page_size=8, prefill_chunk=4)
+    summary = run_loadgen(eng, [Request(rid=i, prompt=list(p), max_new=4)
+                                for i, p in enumerate(PROMPTS[:2])])
+    assert summary["completed"] == 2
+    assert summary["cp"] == 2
+    assert summary["pages_per_rank"] == summary["num_pages"] // 2
+    _assert_drained(eng)
+
+
+def test_slot_engine_refuses_cp_model():
+    """The slot engine replicates per-slot caches — a cp>1 model must be
+    refused at construction, pointing at the paged engine."""
+    mesh, model, params = _setup(2)
+    with pytest.raises(ValueError, match="PAGED"):
+        ContinuousBatchingEngine(model, mesh, params, num_slots=2,
+                                 buf_len=BUF, eos_id=EOS)
+
+
+def test_speculative_refuses_cp_drafter():
+    """SpeculativeEngine's supported shape is target cp>=1, drafter cp=1
+    (the drafter pool is small enough to replicate); a cp>1 drafter is a
+    loud construction-time refusal naming that shape."""
+    from distributed_pytorch_from_scratch_tpu.serving.speculative import (
+        SpeculativeEngine)
+    mesh, model, params = _setup(2)
+    drafter = Transformer(CFG, tp_size=2, cp_size=2)
+    with pytest.raises(ValueError, match="drafter cp=1"):
+        SpeculativeEngine(model, mesh, params, drafter, params,
+                          num_slots=2, buf_len=BUF, eos_id=EOS,
+                          speculate_k=2, page_size=8)

@@ -41,7 +41,10 @@ R5 = os.path.join(REPO, "runs", "r5")
 # r18 run forensics: the archive index over the real runs, two
 # profiled serving arms one knob apart + their pairwise diff, the
 # --explain gate on a forced regression, and the triage/trajectory
-# passes)
+# passes,
+# r19 long-context cp serving: traced cp-contract preflight, the
+# cp{1,2} A/B one knob apart, the 32k-token-prompt capacity arm, the
+# int8-KV cp arm, and the cp2-vs-cp1 regression-gate line)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -54,7 +57,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r15"),
                             os.path.join(REPO, "runs", "r16"),
                             os.path.join(REPO, "runs", "r17"),
-                            os.path.join(REPO, "runs", "r18"))
+                            os.path.join(REPO, "runs", "r18"),
+                            os.path.join(REPO, "runs", "r19"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS

@@ -288,8 +288,8 @@ def test_generate_cli(corpus):
 def test_adamw_cosine_train_then_cp_decode_eval(corpus):
     """Round-4 additions through the REAL CLIs: train with AdamW decoupled
     decay + the cosine schedule, then evaluate with --cp_size 2 — the val
-    forward AND the KV decoder's prefill shard the sequence over 'cp'
-    (ring attention, models/decode.py::_prefill_cp)."""
+    forward shards the sequence over 'cp' (ring attention) and decoding
+    routes through the paged engine's cp-sharded page pool (ISSUE 18)."""
     import subprocess
     import sys
     save = str(corpus["dir"] / "wd_ck")
