@@ -287,13 +287,39 @@ def parse_args(argv=None):
                         "pool below the slot engine's budget. The record "
                         "gains vs_paged (speedup over the non-speculative "
                         "paged arm) + accepted_tokens_per_dispatch")
+    p.add_argument("--fleet", action="store_true",
+                   help="bench the SERVING FLEET (ISSUE 19): "
+                        "--fleet_replicas PagedEngine replicas behind the "
+                        "prefix-cache-aware FleetRouter vs ONE engine at "
+                        "equal total HBM (slots x replicas), PLUS a "
+                        "disaggregated prefill/decode arm (KV pages "
+                        "streamed over serving/transfer.py) vs the same "
+                        "engine colocated. The record carries "
+                        "fleet_tokens_per_sec, per-class fleet SLO "
+                        "attainment, disagg-vs-colocated TTFT/TPOT p95, "
+                        "and the transfer wire (pages, bytes = pages x "
+                        "page_bytes asserted, transfer_ms p95, priced by "
+                        "obs/attribution.kv_transfer_attribution)")
+    p.add_argument("--fleet_replicas", type=int, default=2,
+                   help="--fleet: replicas behind the router (the equal-"
+                        "HBM baseline gets slots x this)")
     args = p.parse_args(argv)
     if args.serving and (args.decode or args.breakdown):
         p.error("--serving excludes --decode/--breakdown")
+    if args.fleet and (args.serving or args.decode or args.breakdown):
+        p.error("--fleet excludes --serving/--decode/--breakdown (it IS "
+                "a serving bench — the fleet-level one)")
+    if args.fleet and args.fleet_replicas < 1:
+        p.error(f"--fleet_replicas must be >= 1, got "
+                f"{args.fleet_replicas}")
+    if args.fleet and args.cp > 1:
+        p.error("--fleet composes with cp inside each replica via "
+                "--serving --cp; the fleet A/B keeps replicas cp=1")
     if args.speculate and not args.serving:
         p.error("--speculate is a --serving mode")
-    if args.kv_dtype != "native" and not args.serving:
-        p.error("--kv_dtype is a --serving knob (the paged KV pool)")
+    if args.kv_dtype != "native" and not (args.serving or args.fleet):
+        p.error("--kv_dtype is a --serving/--fleet knob (the paged KV "
+                "pool)")
     if args.paged_attn != "gather" and not args.serving:
         p.error("--paged_attn is a --serving knob (the paged engine's "
                 "attend impl; training has no page table)")
@@ -371,9 +397,10 @@ def parse_args(argv=None):
         p.error(f"--zero {args.zero} does not compose with MoE presets "
                 f"(expert grads are ep-sharded, not batch-replicated); "
                 f"--zero 1 shards MoE moments fine")
-    if args.zero and (args.serving or args.decode):
+    if args.zero and (args.serving or args.decode or args.fleet):
         p.error("--zero is a training knob; it does not apply to "
-                "--serving/--decode (any stage would be silently ignored)")
+                "--serving/--decode/--fleet (any stage would be silently "
+                "ignored)")
     if args.analytic and not args.breakdown:
         p.error("--analytic is a --breakdown mode")
     if args.analytic and args.remat == "auto":
@@ -1152,6 +1179,173 @@ def run_serving_bench(args, mesh, cfg, tp: int) -> None:
     }))
 
 
+def run_fleet_bench(args, mesh, cfg, tp: int) -> None:
+    """Serving fleet A/B (ISSUE 19): is the router worth its hop, and
+    when does disaggregation win?
+
+    The same shared-prefix mixed-class burst goes through:
+
+    (a) --fleet_replicas PagedEngine replicas behind the prefix-cache-
+        aware FleetRouter (serving/router.py) — each replica at --slots
+        and the per-replica page budget;
+    (b) ONE PagedEngine at slots x replicas and pages x replicas — the
+        SAME total HBM in one pool (vs_baseline = fleet / single; the
+        single engine shares every prefix in one index, so the router's
+        job is to lose as little of that as possible while it buys
+        blast-radius isolation and per-replica restart);
+    (c) disaggregated prefill/decode: a prefill-only engine streams
+        each request's KV pages to a decode engine over the KVPG wire
+        (serving/transfer.py), vs (d) the SAME single engine colocated
+        — disagg_vs_colocated prices the handoff against the prefill/
+        decode interference it removes.
+
+    The record carries fleet_tokens_per_sec + per-class fleet SLO
+    attainment (obs/telemetry.fleet_slo_attainment over the replicas'
+    counters), router dispatch p50/p95, disagg-vs-colocated TTFT/TPOT
+    p95, and the transfer wire: transferred pages, bytes-per-request
+    (asserted = pages x page_bytes — the framing rides separately as
+    transferred_bytes), transfer_ms p95, and the analytic pricing
+    (obs/attribution.kv_transfer_attribution at the DCN rate — a fleet
+    crosses hosts even though this bench runs in-process). Random init,
+    random-id prompts; compiles included in every arm's wall."""
+    from distributed_pytorch_from_scratch_tpu.obs.attribution import (
+        kv_transfer_attribution)
+    from distributed_pytorch_from_scratch_tpu.serving.engine import (
+        PagedEngine)
+    from distributed_pytorch_from_scratch_tpu.serving.kv_manager import (
+        kv_token_bytes, page_bytes)
+    from distributed_pytorch_from_scratch_tpu.serving.loadgen import (
+        _pctl, run_fleet_loadgen, run_loadgen, synthetic_requests)
+    from distributed_pytorch_from_scratch_tpu.serving.router import (
+        FleetRouter)
+    from distributed_pytorch_from_scratch_tpu.serving.scheduler import (
+        parse_slo_classes)
+    from distributed_pytorch_from_scratch_tpu.serving.transfer import (
+        run_disaggregated)
+
+    plen, gen = args.prompt_len, args.gen_tokens
+    if plen < 3 or gen <= 0:
+        raise SystemExit("--fleet needs --prompt_len >= 3 and "
+                         "--gen_tokens >= 1")
+    spl = args.page_size            # one full shared page to route on
+    buf_len = spl + plen + gen + 2
+    if buf_len > cfg.maxlen:
+        cfg = dataclasses.replace(cfg, maxlen=buf_len)
+    model = build_model(args, cfg, tp)
+    params = jax.device_put(model.init(jax.random.key(0)),
+                            model.shardings(mesh))
+    eos = 1
+    R = args.fleet_replicas
+    kv_dtype = None if args.kv_dtype == "native" else args.kv_dtype
+    pb = page_bytes(cfg, args.page_size, kv_dtype)
+    budget_bytes = args.slots * buf_len * kv_token_bytes(cfg)
+    pages_each = max(-(-buf_len // args.page_size),
+                     int(budget_bytes // pb))
+    mix = parse_slo_classes("interactive=1,standard=1")
+
+    def burst():
+        # fresh Request objects each arm — engines mutate them
+        return synthetic_requests(
+            args.serve_requests, max(3, plen // 4), plen, gen,
+            cfg.vocab_size, seed=2, arrival="burst", class_mix=mix,
+            tenants=2, shared_prefix_len=spl, interleave=True)
+
+    def engine(slots, pages, prefill_only=False):
+        return PagedEngine(
+            model, mesh, params, num_slots=slots, buf_len=buf_len,
+            eos_id=eos, page_size=args.page_size, num_pages=pages,
+            prefill_chunk=args.prefill_chunk, kv_dtype=kv_dtype,
+            slo_classes=mix, prefill_only=prefill_only)
+
+    # (a) the fleet behind the router
+    router = FleetRouter([engine(args.slots, pages_each)
+                          for _ in range(R)])
+    fleet = run_fleet_loadgen(router, burst())
+    fleet_rate = fleet["fleet_tokens_per_sec"]
+    print(f"fleet x{R}: {fleet_rate:.1f} tok/s, dispatch p50 "
+          f"{fleet['dispatch_ms_p50']} ms", file=sys.stderr)
+
+    # (b) one engine, same total HBM
+    single = run_loadgen(engine(args.slots * R, pages_each * R), burst())
+    single_rate = single["tokens_per_sec"]
+    print(f"single slots x{R}: {single_rate:.1f} tok/s", file=sys.stderr)
+
+    # (c) disaggregated prefill/decode over the page stream
+    disagg = run_disaggregated(engine(args.slots, pages_each,
+                                      prefill_only=True),
+                               engine(args.slots, pages_each), burst())
+    done = disagg["completed"]
+    ms = 1e3
+    disagg_gen = sum(len(r.tokens) for r in done)
+    disagg_rate = disagg_gen / max(disagg["wall_s"], 1e-9)
+    disagg_ttft = _pctl([r.ttft_s and r.ttft_s * ms for r in done], 95)
+    disagg_tpot = _pctl([r.tpot_s and r.tpot_s * ms for r in done], 95)
+    print(f"disagg: {disagg_rate:.1f} tok/s, transfer p95 "
+          f"{disagg['transfer_ms_p95']} ms", file=sys.stderr)
+
+    # (d) colocated comparator: one replica-sized engine doing both
+    coloc = run_loadgen(engine(args.slots, pages_each), burst())
+    coloc_rate = coloc["tokens_per_sec"]
+
+    # the wire, asserted: the priced bytes ARE pages x page_bytes (the
+    # JSON framing rides separately in transferred_bytes)
+    pricing = kv_transfer_attribution(disagg["transferred_pages"], pb,
+                                      link="dcn",
+                                      measured_ms=disagg["transfer_ms_p50"])
+    assert pricing["bytes_each"] == disagg["transferred_pages"] * pb, \
+        (pricing["bytes_each"], disagg["transferred_pages"], pb)
+    kv_bytes_per_req = round(disagg["transferred_pages"] * pb
+                             / max(len(done), 1), 1)
+
+    slo = fleet.get("fleet_slo_attainment") or {}
+    slo_min = min((v["attained"] for v in slo.values()), default=None)
+    print(json.dumps({
+        "metric": (f"serving fleet tokens/sec ({args.model} "
+                   f"{args.family}, {R}x PagedEngine slots{args.slots} "
+                   f"behind the prefix-aware router; vs_baseline = fleet "
+                   f"/ ONE engine at slots{args.slots * R} equal total "
+                   f"HBM; disagg_vs_colocated = prefill/decode split "
+                   f"over the KV page stream / the same one-replica "
+                   f"engine colocated; {args.serve_requests}-request "
+                   f"long/short burst, {spl}-token shared prefix, "
+                   f"prompt {max(3, plen // 4)}/{plen}, gen {gen})"),
+        "value": round(fleet_rate, 1),
+        "unit": "tokens/sec (fleet)",
+        "fleet_replicas": R,
+        "fleet_tokens_per_sec": round(fleet_rate, 1),
+        "vs_baseline": round(fleet_rate / max(single_rate, 1e-9), 3),
+        "single_rate": round(single_rate, 1),
+        "dispatch_ms_p50": fleet["dispatch_ms_p50"],
+        "dispatch_ms_p95": fleet["dispatch_ms_p95"],
+        "session_spills": fleet["session_spills"],
+        "rejected": fleet["rejected"],
+        "ttft_ms_p95": fleet["ttft_ms_p95"],
+        "tpot_ms_p95": fleet["tpot_ms_p95"],
+        "per_replica": fleet["per_replica"],
+        "fleet_slo_attainment": slo,
+        "fleet_slo_attainment_min": slo_min,
+        "kv_dtype": args.kv_dtype,
+        "num_pages": pages_each,
+        "page_bytes": pb,
+        # the disagg A/B + the wire it pays for
+        "disagg_rate": round(disagg_rate, 1),
+        "coloc_rate": round(coloc_rate, 1),
+        "disagg_vs_colocated": round(disagg_rate / max(coloc_rate, 1e-9),
+                                     3),
+        "disagg_ttft_ms_p95": disagg_ttft,
+        "coloc_ttft_ms_p95": coloc["ttft_ms_p95"],
+        "disagg_tpot_ms_p95": disagg_tpot,
+        "coloc_tpot_ms_p95": coloc["tpot_ms_p95"],
+        "transfer_ms_p50": disagg["transfer_ms_p50"],
+        "transfer_ms_p95": disagg["transfer_ms_p95"],
+        "transferred_pages": disagg["transferred_pages"],
+        "transferred_bytes": disagg["transferred_bytes"],
+        "transfer_bytes_per_request": kv_bytes_per_req,
+        "transfer_attribution": pricing,
+        **run_stamp(vars(args)),
+    }))
+
+
 def run_breakdown(args, mesh, cfg, tp: int) -> None:
     """Where does the step time go? (VERDICT r4 #3 / r5 #1.)
 
@@ -1534,10 +1728,12 @@ def main(argv=None):
                                   args.seqlen or cfg.maxlen,
                                   tp=tp, world=args.dp * tp,
                                   zero_stage=args.zero, dp=args.dp)
-    if args.decode or args.breakdown or args.serving:
-        if args.introspect and (args.decode or args.serving):
+    if args.decode or args.breakdown or args.serving or args.fleet:
+        if args.introspect and (args.decode or args.serving or args.fleet):
             print("bench: --introspect does not apply to --decode/"
-                  "--serving; ignoring it", file=sys.stderr)
+                  "--serving/--fleet; ignoring it", file=sys.stderr)
+        if args.fleet:
+            return run_fleet_bench(args, mesh, cfg, tp)
         if args.serving:
             return run_serving_bench(args, mesh, cfg, tp)
         if args.decode:

@@ -13,7 +13,7 @@ buckets, sentinel thresholds, flight-dump walkthrough, live endpoints).
 """
 
 from .attribution import (attribution, flash_tile_stats, format_attribution,
-                          rank_skew)
+                          kv_transfer_attribution, rank_skew)
 from .collector import FleetCollector, JsonlTailer
 from .control import (CONTROL_MODES, Knob, RetuneAdvisor,
                       control_safe_point)
@@ -47,7 +47,8 @@ __all__ = [
     "control_safe_point", "diff_runs", "flash_tile_stats",
     "fleet_slo_attainment", "format_analysis", "format_attribution",
     "format_card", "format_diff", "format_reconcile",
-    "format_trajectory", "index_repo", "merge_traces", "outage_reason",
+    "format_trajectory", "index_repo", "kv_transfer_attribution",
+    "merge_traces", "outage_reason",
     "parse_capture", "parse_collectives", "rank_skew", "reconcile",
     "run_stamp", "trajectory_report", "validate_jsonl",
     "validate_record",

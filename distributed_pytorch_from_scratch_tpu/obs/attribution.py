@@ -979,6 +979,45 @@ def expected_collectives(tp: int = 1, sp: bool = False,
 
 # -- cross-rank skew attribution (ISSUE 10) ------------------------------
 
+DCN_BANDWIDTH = 25e9   # bytes/s per host NIC, the cross-host default
+
+
+def kv_transfer_attribution(pages: int, page_bytes_each: int,
+                            chip: str = "v5e", link: str = "ici",
+                            measured_ms: Optional[float] = None) -> Dict:
+    """Price one disaggregated prefill->decode KV page handoff (ISSUE
+    19) in the comm-attribution record shape: bytes on the wire are
+    EXACTLY pages x page_bytes (the transfer ships whole pages —
+    bench.py --fleet asserts its measured per-request bytes against
+    this), serialized over the chosen link's alpha-beta terms. `link`:
+    'ici' (same-pod reshard, ICI_SPECS bandwidth) or 'dcn' (cross-host,
+    the DCN_BANDWIDTH NIC default). `measured_ms` (the transfer span
+    from obs.reqtrace's handoff gap, or serving.transfer's in-process
+    clock) rides along so reports show expected vs observed; the wire
+    is never overlapped with compute — a handoff serializes the
+    request's path — so exposed == serialized."""
+    if pages < 0 or page_bytes_each < 0:
+        raise ValueError(f"pages/page_bytes must be >= 0, got "
+                         f"{pages}/{page_bytes_each}")
+    if link not in ("ici", "dcn"):
+        raise ValueError(f"link must be 'ici' or 'dcn', got {link!r}")
+    ici_bw, lat = ICI_SPECS.get(chip, ICI_SPECS["v5e"])
+    bw = ici_bw if link == "ici" else DCN_BANDWIDTH
+    nbytes = pages * page_bytes_each
+    ms = (nbytes / bw + lat) * 1e3
+    rec = {
+        "name": "kv_page_transfer", "kind": "handoff", "count": 1,
+        "bytes_each": nbytes, "serialized_ms": round(ms, 6),
+        "hidden_ms": 0.0, "exposed_ms": round(ms, 6),
+        "note": f"{pages} pages x {page_bytes_each} B over {link} "
+                f"({chip}): the prefill->decode page stream",
+        "pages": pages, "page_bytes": page_bytes_each, "link": link,
+    }
+    if measured_ms is not None:
+        rec["measured_ms"] = round(float(measured_ms), 3)
+    return rec
+
+
 def rank_skew(records: List[Dict], tol: float = 0.20) -> Optional[Dict]:
     """Rank cross-rank straggler suspects from per-process phase timings.
 

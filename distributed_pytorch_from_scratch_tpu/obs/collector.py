@@ -119,6 +119,11 @@ class JsonlTailer:
             self.rotations += 1
 
 
+# sentinel: a scrape that missed the liveness deadline (vs None, a fast
+# failure) — poll() counts the two differently
+_HUNG = object()
+
+
 class FleetCollector:
     """Fold every proc's stream under `log_dirs` into fleet rollups.
 
@@ -128,7 +133,11 @@ class FleetCollector:
 
     def __init__(self, log_dirs, endpoints=None, out_path: Optional[str] = None,
                  clock: Callable[[], float] = time.monotonic,
-                 wall: Callable[[], float] = time.time):
+                 wall: Callable[[], float] = time.time,
+                 scrape_timeout: float = 0.5):
+        if scrape_timeout <= 0:
+            raise ValueError(f"scrape_timeout must be > 0, got "
+                             f"{scrape_timeout}")
         self.log_dirs = [log_dirs] if isinstance(log_dirs, str) \
             else list(log_dirs)
         self.endpoints = list(endpoints or [])
@@ -142,6 +151,14 @@ class FleetCollector:
         self._lock = threading.Lock()
         self.rollups = 0
         self.scrape_errors = 0
+        # liveness bound per endpoint scrape (ISSUE 19): a HUNG replica —
+        # accepts the connection, never answers — must not block the
+        # whole collector tick. The scrape runs in a worker joined with
+        # this deadline; endpoints that miss it count here (the scrape
+        # -side mirror of the hbm block's `procs_unavailable`).
+        self.scrape_timeout = float(scrape_timeout)
+        self.procs_unresponsive = 0     # endpoints past deadline, last poll
+        self.unresponsive_scrapes = 0   # cumulative across polls
 
     # -- discovery --------------------------------------------------------
     def discover(self) -> List[str]:
@@ -174,28 +191,56 @@ class FleetCollector:
             for rec in tailer.poll():
                 self._fold(key, rec)
                 n += 1
+        unresponsive = 0
         for url in self.endpoints:
             snap = self._scrape(url)
-            if snap is not None:
+            if snap is _HUNG:
+                unresponsive += 1
+            elif snap is not None:
                 self._fold(url, {"tag": "telemetry_snapshot",
                                  "schema_version": EVENT_SCHEMA_VERSION,
                                  "gauges": snap.get("gauges", {}),
                                  "counters": snap.get("counters", {}),
                                  "process": snap.get("process", 0)})
                 n += 1
+        self.procs_unresponsive = unresponsive
+        self.unresponsive_scrapes += unresponsive
         return n
 
-    def _scrape(self, url: str) -> Optional[dict]:
+    def _scrape(self, url: str):
+        """One endpoint fetch under a HARD liveness deadline: the HTTP
+        round trip runs in a worker thread joined with `scrape_timeout`.
+        The socket-level timeout alone is not a liveness bound — a
+        replica that accepts the connection and then drips (or just
+        hangs inside accept/headers) can hold a blocking urlopen for the
+        full socket timeout per endpoint, serially stalling every tick.
+        Returns the parsed snapshot, None on a FAST failure (connection
+        refused: a dead replica is a fleet fact, counted in
+        scrape_errors), or _HUNG past the deadline (counted by poll as
+        procs_unresponsive; the abandoned worker dies on its own socket
+        timeout)."""
         import urllib.request
-        try:
-            with urllib.request.urlopen(url.rstrip("/") + "/metrics.json",
-                                        timeout=2.0) as r:
-                return json.loads(r.read())
-        except Exception:
-            # a dead replica is a fleet FACT, not a collector crash; the
-            # rollup simply stops carrying its snapshot
-            self.scrape_errors += 1
-            return None
+
+        box: list = []
+
+        def fetch():
+            try:
+                with urllib.request.urlopen(
+                        url.rstrip("/") + "/metrics.json",
+                        timeout=self.scrape_timeout) as r:
+                    box.append(json.loads(r.read()))
+            except Exception:
+                box.append(None)
+
+        w = threading.Thread(target=fetch, daemon=True)
+        w.start()
+        w.join(self.scrape_timeout)
+        if w.is_alive():
+            return _HUNG
+        if box and box[0] is not None:
+            return box[0]
+        self.scrape_errors += 1
+        return None
 
     def _fold(self, key: str, rec: dict) -> None:
         tag = rec.get("tag")
@@ -334,6 +379,11 @@ class FleetCollector:
             "tokens_per_sec": round(tokens_per_sec, 2),
             "slo_attainment": fleet_slo_attainment(slo_inputs),
         }
+        if self.endpoints:
+            # scrape liveness (ISSUE 19): endpoints that missed the last
+            # poll's deadline — the procs_unavailable convention, applied
+            # to the scrape path
+            out["procs_unresponsive"] = self.procs_unresponsive
         if pages_total:
             out["pool"] = {
                 "pages_in_use": pages_used,

@@ -141,7 +141,12 @@ class RequestTracer:
         else:
             trace_id = f"r{req.rid}.{self._seq}"
         req.trace_id = trace_id
-        t = req.submit_t if t is None else t
+        # a CONTINUED trace starts its local segment at adoption time —
+        # the origin's record already covers [submit_t, export], and an
+        # in-process chain reuses the same Request object, so defaulting
+        # to its (stale) submit_t would overlap the two hops' records
+        # and break the merged span-sum == wall contract (merge_traces)
+        t = (req.submit_t if ctx is None else None) if t is None else t
         if t is None:
             t = self._clock()
         # anchor the wall timebase at t0 even when submit_t was backdated

@@ -58,6 +58,10 @@ HEADLINE_FIELDS = (
     "metric", "unit", "value", "vs_baseline", "paged_vs_slot",
     "accepted_tokens_per_dispatch", "ttft_ms_p95", "tpot_ms_p95",
     "decode_hbm_bytes_per_step", "tokens_per_sec",
+    # serving fleet (ISSUE 19): bench --fleet / serve_fleet records
+    "fleet_tokens_per_sec", "fleet_slo_attainment_min",
+    "disagg_vs_colocated", "transfer_ms_p95",
+    "transfer_bytes_per_request",
 )
 
 _BACKEND_RE = re.compile(r"device\(s\)\s*\[([^\]]+)\]")

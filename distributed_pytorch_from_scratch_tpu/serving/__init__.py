@@ -20,22 +20,34 @@ round with a cheap drafter model over its own paged pool and verifies
 them in ONE target dispatch with exact rejection sampling — greedy output
 token-identical to the paged engine, sampled output distribution-
 identical, pinned in tests/test_speculative.py.
+
+Serving fleet v1 (ISSUE 19): `FleetRouter` dispatches across N replicas
+by predicted prefix-cache hit (a host-side shadow of the pool's chain
+index) blended with least-loaded, with session affinity + loud spill;
+`transfer.py` streams a prefilled request's KV pages to a decode-side
+engine over a length-prefixed socket (disaggregated prefill/decode,
+token-identical to colocated, any tp/cp widths). Pinned in
+tests/test_fleet.py; `scripts/serve_fleet.py` is the CLI.
 """
 
 from .engine import (ContinuousBatchingEngine, PagedEngine, Request,
                      decode_prompts)
 from .kv_manager import (KVCachePool, PagedKVPool, PoolExhausted,
                          kv_token_bytes, page_bytes)
-from .loadgen import run_loadgen, slo_attainment, synthetic_requests
+from .loadgen import (run_fleet_loadgen, run_loadgen, slo_attainment,
+                      synthetic_requests)
+from .router import FleetRouter
 from .scheduler import (DEFAULT_SLO_CLASSES, FIFOScheduler, QueueFull,
                         SLOScheduler, bucket_width, parse_slo_classes)
 from .speculative import SpeculativeEngine
+from .transfer import (recv_handoff, run_disaggregated, send_handoff)
 
 __all__ = [
     "ContinuousBatchingEngine", "DEFAULT_SLO_CLASSES", "FIFOScheduler",
-    "KVCachePool", "PagedEngine", "PagedKVPool", "PoolExhausted",
-    "QueueFull", "Request", "SLOScheduler", "SpeculativeEngine",
-    "bucket_width", "decode_prompts", "kv_token_bytes", "page_bytes",
-    "parse_slo_classes", "run_loadgen", "slo_attainment",
-    "synthetic_requests",
+    "FleetRouter", "KVCachePool", "PagedEngine", "PagedKVPool",
+    "PoolExhausted", "QueueFull", "Request", "SLOScheduler",
+    "SpeculativeEngine", "bucket_width", "decode_prompts",
+    "kv_token_bytes", "page_bytes", "parse_slo_classes", "recv_handoff",
+    "run_disaggregated", "run_fleet_loadgen", "run_loadgen",
+    "send_handoff", "slo_attainment", "synthetic_requests",
 ]

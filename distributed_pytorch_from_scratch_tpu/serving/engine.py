@@ -613,7 +613,8 @@ class PagedEngine:
                  paged_attn_interpret: bool = False,
                  tracer=None, writer=None, request_tracer=None,
                  flight=None, telemetry=None, duty_profiler=None,
-                 controller=None, clock=time.monotonic):
+                 controller=None, clock=time.monotonic,
+                 prefill_only: bool = False):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         if page_size < 1:
@@ -714,6 +715,15 @@ class PagedEngine:
         self._step_fn = self._build_step()
         self._chunk_fns: Dict[int, object] = {}
         self.completed: List[Request] = []
+        # disaggregated serving (ISSUE 19): a prefill_only engine never
+        # decodes — finished prefills park in `handoffs` (page refs held
+        # by the ledger) until the caller streams them out and calls
+        # finish_handoff; a decode engine adopts them via admit_prefilled
+        self.prefill_only = bool(prefill_only)
+        self.handoffs: deque = deque()
+        self.handoffs_staged = 0
+        self.pages_exported = 0
+        self.pages_imported = 0
         # -- aggregate stats ---------------------------------------------
         self.decode_steps = 0
         self.generated_tokens = 0
@@ -1142,6 +1152,9 @@ class PagedEngine:
         now = self._clock()
         if req.first_token_t is None:
             req.first_token_t = now
+        if self.prefill_only:
+            self._stage_handoff(slot, st, int(first), now)
+            return
         if first == self.eos_id:              # 0 (more) generated tokens
             req.finish_t = now
             freed = self._release_slot(slot)
@@ -1153,6 +1166,115 @@ class PagedEngine:
         self._tokens[slot] = first
         self._pos[slot] = len(st.ids)
         self._seeds[slot] = np.uint32(req.seed)
+
+    # -- disaggregated prefill/decode handoff (ISSUE 19) ------------------
+    def _stage_handoff(self, slot: int, st: _PrefillState, first: int,
+                       now: float) -> None:
+        """Park a finished prefill for stream-out instead of decoding:
+        the page-table row detaches into the handoff ledger WITH its
+        references — the pages (and their prefix-index registrations)
+        stay live for export_pages and for sharing with later prefills —
+        until finish_handoff drops them after the transfer. The slot
+        frees immediately, so a prefill_only engine's slot count bounds
+        concurrent prefills, not in-flight handoffs."""
+        n_pages = -(-len(st.ids) // self.page_size)
+        pages = [int(self._tbl[slot, j]) for j in range(n_pages)]
+        self._tbl[slot, :] = self.pool.scratch_page
+        self._pos[slot] = 0
+        self._free_slots.append(slot)
+        self.handoffs.append({"req": st.req, "pages": pages,
+                              "first": first, "n_tokens": len(st.ids)})
+        self.handoffs_staged += 1
+        if self.rt is not None:
+            self.rt.mark(st.req, "prefill_done", now, pages=n_pages)
+
+    def export_handoff(self, h) -> tuple:
+        """Host payload for one staged handoff: (k, v) from
+        PagedKVPool.export_pages over the request's page list (global
+        head layout — the importer reshards under its own tp width)."""
+        k, v = self.pool.export_pages(h["pages"])
+        self.pages_exported += len(h["pages"])
+        return k, v
+
+    def finish_handoff(self, h) -> None:
+        """Drop the ledger's page references once the receiving pool
+        holds its own copies (shared prefix pages survive for their
+        other referents), and retire the local trace record — the decode
+        side continues the trace from the exported context."""
+        for p in h["pages"]:
+            self.pool.unref(p)
+        if self.rt is not None:
+            self.rt.retire(h["req"])
+
+    def admit_prefilled(self, req: Request, k, v, first: int) -> int:
+        """Disaggregated decode intake: lease + import pages for an
+        ALREADY-PREFILLED request (payload from export_pages on the
+        prefill side — any tp/cp width) and install the slot state
+        exactly as _finish_prefill would, so the decode loop continues
+        token-identically to colocated serving (position math depends
+        only on the prefix, and the prefix bytes just arrived). Returns
+        the slot used, or -1 when the request completed immediately
+        (first == eos, or max_new exhausted). Raises RuntimeError when
+        no slot is free and PoolExhausted when the pool is — both are
+        the caller's backpressure signals; nothing is partially
+        admitted."""
+        ids = req.prompt + req.tokens
+        n_pages = -(-len(ids) // self.page_size)
+        if n_pages > self.max_pages:
+            raise ValueError(
+                f"handoff {req.rid}: {len(ids)} prefilled tokens need "
+                f"{n_pages} page-table columns but the row has "
+                f"{self.max_pages} (buf_len {self.buf_len})")
+        need = -(-min(len(ids) + req.max_new, self.buf_len)
+                 // self.page_size)
+        if need > self.pool.num_pages:
+            raise ValueError(
+                f"handoff {req.rid}: worst case {need} pages exceeds the "
+                f"pool's {self.pool.num_pages} — raise --num_pages")
+        if not self._free_slots:
+            raise RuntimeError(
+                f"no free slot for handoff {req.rid} "
+                f"({self.num_slots} slots busy)")
+        now = self._clock()
+        if req.submit_t is None:
+            req.submit_t = now
+        req.admit_t = now
+        req.prompt_len = len(req.prompt)
+        req.limit = min(req.prompt_len + req.max_new, self.buf_len)
+        self.prompt_tokens += req.prompt_len
+        if self.rt is not None:
+            self.rt.begin(req, ctx=_wire_ctx(req))
+        pages = self.pool.import_pages(
+            k, v, owners=[j // self._mpp for j in range(n_pages)])
+        self.pages_imported += len(pages)
+        slot = self._free_slots.popleft()
+        for j, p in enumerate(pages):
+            self._tbl[slot, j] = p
+        # register the imported prompt pages so later LOCAL arrivals
+        # share them exactly as a locally prefilled donor's
+        keys = self._chain_keys(ids)
+        ps = self.page_size
+        for j in range(n_pages):
+            self.pool.register_prefix(keys[j - 1] if j else None, pages[j],
+                                      ids[j * ps:min((j + 1) * ps,
+                                                     len(ids))])
+        if self.rt is not None:
+            self.rt.mark(req, "kv_import", self._clock(), pages=n_pages)
+        if req.first_token_t is None:
+            req.first_token_t = self._clock()
+        if int(first) == self.eos_id or req.limit <= len(ids):
+            req.finish_t = self._clock()
+            freed = self._release_slot(slot)
+            if self.rt is not None:
+                self.rt.note(req, pages_freed=freed)
+            self._complete(req, [])
+            return -1
+        self._slot_req[slot] = req
+        self._tokens[slot] = int(first)
+        self._pos[slot] = len(ids)
+        self._seeds[slot] = np.uint32(req.seed)
+        self.max_live = max(self.max_live, self.live_requests)
+        return slot
 
     def _decode(self, done: List[Request]) -> None:
         # grow/privatise the write page of every live slot FIRST — this
@@ -1354,4 +1476,9 @@ class PagedEngine:
             "preemptions": self.preemptions,
             "max_live": self.max_live,
             "max_interleaved_prefill_positions": self.max_interleaved_prefill,
+            # -- disaggregated handoff (ISSUE 19) ------------------------
+            "prefill_only": self.prefill_only,
+            "handoffs_staged": self.handoffs_staged,
+            "pages_exported": self.pages_exported,
+            "pages_imported": self.pages_imported,
         }

@@ -275,6 +275,7 @@ class PagedKVPool:
         self._page_keys = {}    # page_id -> parent chain_key (for dereg)
         self.cow_copies = 0
         self._copy_fns = {}
+        self._import_fns = {}
 
     # -- page leasing -----------------------------------------------------
     @property
@@ -427,6 +428,111 @@ class PagedKVPool:
         if self.flight is not None:
             self.flight.record("cow_copy", pages=len(pairs),
                                free_pages=self.free_pages)
+
+    # -- host-side page transfer (serving fleet v1, ISSUE 19) -------------
+    def _page_index(self, page: int) -> int:
+        """GLOBAL page id -> index into the pool array's page dim. The
+        cp-sharded layout interleaves one rank-local scratch entry after
+        every rank's slab (page dim is num_pages + cp), so global page p
+        lives at (p // ppr) * (ppr + 1) + p % ppr; cp == 1 degenerates to
+        the identity."""
+        if not 0 <= page < self.num_pages:
+            raise ValueError(f"page {page} out of range "
+                             f"[0, {self.num_pages})")
+        ppr = self.pages_per_rank
+        return (page // ppr) * (ppr + 1) + page % ppr
+
+    def export_pages(self, pages):
+        """Bulk host-side READ of `pages` (global ids, any order) for
+        streaming to another pool (serving/transfer.py): returns (k, v)
+        where each is a numpy array of shape
+        (layers, len(pages), kv_heads, page_size, head_dim) — native
+        pools — or an int8 (codes, scales) numpy pair for int8 pools
+        (scales shaped (layers, len(pages), kv_heads, page_size)).
+
+        The read materialises the GLOBAL head dim whatever 'tp' sharded
+        it (jax presents addressable sharded arrays globally), so an
+        importer at a DIFFERENT tp width just scatters the payload under
+        its own sharding — the any-layout-to-any-layout reshard the
+        cross-mesh transfer papers formalise, done host-side at page
+        granularity. Pages stay leased; exporting does not change
+        refcounts."""
+        idx = np.asarray([self._page_index(int(p)) for p in pages],
+                         np.int64)
+        take = lambda a: np.asarray(a[:, idx])
+        return jax.tree.map(take, self.ks), jax.tree.map(take, self.vs)
+
+    def import_pages(self, k, v, owners=None) -> List[int]:
+        """Bulk LEASE + WRITE of a payload produced by `export_pages` on
+        another pool (possibly different tp/cp width): leases one page
+        per payload entry (refcount 1 — the caller's page-table row owns
+        them), scatters K and V in ONE donating device dispatch (pow2-
+        bucketed like copy_pages, pads aimed at the scratch entry), and
+        returns the global page ids in payload order. `owners[i]` names
+        the cp slab page i must come from (page-table column ownership);
+        default all slab 0 (cp == 1). On PoolExhausted every page leased
+        so far is returned before the raise — no partial lease leaks."""
+        if self.kv_dtype:
+            if not (isinstance(k, tuple) and isinstance(v, tuple)):
+                raise ValueError("int8 pool import needs (codes, scales) "
+                                 "payload tuples (export_pages on an int8 "
+                                 "pool produces them)")
+            n, ps, hd = k[0].shape[1], k[0].shape[3], k[0].shape[4]
+        else:
+            if isinstance(k, tuple) or isinstance(v, tuple):
+                raise ValueError("native pool cannot import an int8 "
+                                 "(codes, scales) payload — kv_dtype must "
+                                 "match across the transfer")
+            n, ps, hd = k.shape[1], k.shape[3], k.shape[4]
+        if ps != self.page_size:
+            raise ValueError(f"payload page_size {ps} != pool page_size "
+                             f"{self.page_size} (pages are the transfer "
+                             f"unit; both sides must agree)")
+        want_hd = (self.ks[0] if self.kv_dtype else self.ks).shape[4]
+        if hd != want_hd:
+            raise ValueError(f"payload head_dim {hd} != pool head_dim "
+                             f"{want_hd} (different model shapes)")
+        if owners is not None and len(owners) != n:
+            raise ValueError(f"owners has {len(owners)} entries for {n} "
+                             f"payload pages")
+        pages: List[int] = []
+        try:
+            for i in range(n):
+                pages.append(self.alloc(owners[i] if owners else 0))
+        except PoolExhausted:
+            for p in pages:
+                self.unref(p)
+            raise
+        m = 1
+        while m < n:
+            m *= 2
+        # pad entries aim at slab 0's scratch entry (array index ppr) and
+        # rewrite it with payload page 0 — scratch is quarantined garbage
+        # by contract, so the duplicate-index scatter is harmless
+        idx = np.full(m, self.pages_per_rank, np.int32)
+        for i, p in enumerate(pages):
+            idx[i] = self._page_index(p)
+        pad = lambda a: np.concatenate(
+            [a, np.repeat(a[:, :1], m - n, axis=1)], axis=1) if m > n else a
+        nk = jax.tree.map(pad, k)
+        nv = jax.tree.map(pad, v)
+        if m not in self._import_fns:
+            self._import_fns[m] = self._build_import()
+        ks, vs = self._import_fns[m](self.ks, self.vs, nk, nv,
+                                     jnp.asarray(idx))
+        self.adopt(ks, vs)
+        return pages
+
+    def _build_import(self):
+        sh = self._sharding
+
+        def fn(pk, pv, nk, nv, idx):
+            # dim 1 is the page dim for codes (5-D) and scales (4-D)
+            # alike; one tree-mapped scatter serves both pool layouts
+            put = lambda a, b: a.at[:, idx].set(b.astype(a.dtype))
+            return jax.tree.map(put, pk, nk), jax.tree.map(put, pv, nv)
+
+        return jax.jit(fn, donate_argnums=(0, 1), out_shardings=(sh, sh))
 
     # -- device-array handoff ---------------------------------------------
     def adopt(self, ks, vs) -> None:

@@ -278,6 +278,106 @@ def run_loadgen(engine: ContinuousBatchingEngine, requests: List[Request],
     return summary
 
 
+def run_fleet_loadgen(router, requests: List[Request],
+                      clock=time.monotonic, sleep=time.sleep,
+                      session_key=None) -> dict:
+    """run_loadgen generalized to a FleetRouter (serving fleet v1,
+    ISSUE 19): the arrival stream submits through the router — scored
+    dispatch, session affinity keyed by `session_key(req)` (default the
+    request's tenant: a multi-turn chat reuses its tenant's replica and
+    its KV prefix) — and every engine step advances the WHOLE fleet.
+
+    The summary is fleet-level: throughput sums the replicas, latency
+    percentiles pool every completion, `fleet_slo_attainment` folds the
+    replicas' live per-class counters exactly as obs.collector's rollup
+    does, and `per_replica` carries each engine's dispatched/completed/
+    prefix_hit_rate so a skewed router shows up in one read. Router
+    dispatch overhead rides along (`dispatch_ms_p50` — the < 1 ms CPU
+    pin)."""
+    import sys
+
+    from ..obs.telemetry import fleet_slo_attainment
+
+    if session_key is None:
+        session_key = lambda r: r.tenant
+    pending = sorted(requests, key=lambda r: r.arrival)
+    t0 = clock()
+    i = 0
+    invalid = 0
+    done: List[Request] = []
+    while i < len(pending) or router.has_work():
+        now = clock() - t0
+        while i < len(pending) and pending[i].arrival <= now:
+            try:
+                pending[i].submit_t = t0 + pending[i].arrival
+                router.submit(pending[i], session=session_key(pending[i]))
+            except QueueFull:
+                pass  # counted by the router (fleet-wide refusal)
+            except ValueError as e:
+                invalid += 1
+                print(f"fleet loadgen: request {pending[i].rid} invalid: "
+                      f"{e}", file=sys.stderr)
+            i += 1
+        if router.has_work():
+            done.extend(router.step())
+        elif i < len(pending):
+            sleep(min(0.05, max(0.0, pending[i].arrival - (clock() - t0))))
+    wall = max(clock() - t0, 1e-9)
+    ms = 1e3
+    rstats = router.stats()
+    engines = [(name, eng) for name, eng in router.replicas]
+    generated = sum(e.generated_tokens for _, e in engines)
+    per_replica = {}
+    for name, eng in engines:
+        st = eng.stats()
+        per_replica[name] = {
+            "dispatched": rstats["dispatched"].get(name, 0),
+            "completed": st["completed"],
+            "generated_tokens": st["generated_tokens"],
+            "rejected": st["rejected"],
+            "prefix_hit_rate": st.get("prefix_hit_rate", 0.0),
+            "preemptions": st.get("preemptions", 0),
+            "num_pages": st.get("num_pages"),
+            "pages_in_use_mean": st.get("pages_in_use_mean"),
+        }
+    summary = {
+        "requests": len(requests),
+        "completed": len(done),
+        "rejected": rstats["rejected"],
+        "invalid": invalid,
+        "wall_s": round(wall, 4),
+        "generated_tokens": generated,
+        "fleet_tokens_per_sec": round(generated / wall, 2),
+        "replicas": rstats["replicas"],
+        "dispatch_ms_p50": rstats["dispatch_ms_p50"],
+        "dispatch_ms_p95": rstats["dispatch_ms_p95"],
+        "session_spills": rstats["spills"],
+        "ttft_ms_p50": _pctl([r.ttft_s and r.ttft_s * ms for r in done], 50),
+        "ttft_ms_p95": _pctl([r.ttft_s and r.ttft_s * ms for r in done], 95),
+        "tpot_ms_p50": _pctl([r.tpot_s and r.tpot_s * ms for r in done], 50),
+        "tpot_ms_p95": _pctl([r.tpot_s and r.tpot_s * ms for r in done], 95),
+        "queue_wait_ms_p50": _pctl(
+            [r.queue_wait_s and r.queue_wait_s * ms for r in done], 50),
+        "queue_wait_ms_p95": _pctl(
+            [r.queue_wait_s and r.queue_wait_s * ms for r in done], 95),
+        "per_replica": per_replica,
+    }
+    # fold the replicas' LIVE per-class counters the same way the fleet
+    # collector does, so the loadgen summary and the rollup agree
+    slo_inputs = []
+    for _, eng in engines:
+        counts = getattr(eng, "_slo_counts", None)
+        if counts:
+            slo_inputs.append({cls: (c[0], c[1])
+                               for cls, c in counts.items()})
+    att = fleet_slo_attainment(slo_inputs) if slo_inputs else None
+    if att:
+        summary["fleet_slo_attainment"] = att
+    if router.writer is not None:
+        router.writer.event("fleet_serving_summary", **summary)
+    return summary
+
+
 def slo_attainment(engine, done) -> Optional[dict]:
     """Per-deadline-class TTFT attainment: of the requests that COMPLETED
     in each class, the fraction whose TTFT met the class budget (plus the

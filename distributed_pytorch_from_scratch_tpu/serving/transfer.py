@@ -1,0 +1,297 @@
+"""KV page streaming for disaggregated prefill/decode (ISSUE 19).
+
+A prefill replica finishes a request's chunked prefill into its own
+paged pool, then ships the request — metadata plus the fixed-size KV
+*pages* backing its prompt — to a decode replica over a length-prefixed
+socket stream. The decode side leases pages out of its own pool
+(`PagedEngine.admit_prefilled` -> `PagedKVPool.import_pages`) and the
+request continues through the unmodified decode loop, token-identically
+to colocated serving.
+
+Wire format (docs/SERVING.md "Serving fleet v1"): one frame per
+handoff —
+
+    magic  b"KVPG"
+    u32    header length (big-endian)
+    bytes  header: UTF-8 JSON — request fields, first sampled token,
+           kv kind ('native' | 'int8'), page_size, n_tokens, the
+           TraceContext wire dict, and per-blob {dtype, shape} metadata
+    per blob: u64 length (big-endian) + raw C-order bytes
+
+Blobs are the export_pages payload flattened in tree order: native
+pools send [k, v]; int8 pools send [k_codes, k_scales, v_codes,
+v_scales]. export_pages materializes the GLOBAL head layout, so the
+receiving pool's tp width need not match the sender's — the reshard is
+implicit in the import scatter ("Memory-efficient array redistribution
+through portable collective communication", PAPERS.md, done host-side
+at page granularity).
+
+`run_disaggregated` is the in-process reference driver (socketpair,
+prefill thread + receiver thread + decode loop) used by tests and
+`bench.py --fleet`; a real deployment runs the same frame protocol over
+TCP between hosts.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .engine import PagedEngine, Request
+from .kv_manager import PoolExhausted
+
+MAGIC = b"KVPG"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype by `dtype.name`, reaching into ml_dtypes for the
+    jax-only names (bfloat16, ...) numpy itself cannot parse."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes; None on clean EOF at a frame boundary
+    (0 bytes read so far). A mid-frame EOF is a protocol error."""
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            if not buf:
+                return None
+            raise ConnectionError(
+                f"page stream truncated mid-frame: wanted {n} bytes, "
+                f"got {len(buf)}")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_frame(sock: socket.socket, header: dict,
+               blobs: List[np.ndarray]) -> int:
+    """One length-prefixed frame; returns total bytes put on the wire."""
+    hdr = json.dumps(header).encode("utf-8")
+    parts = [MAGIC, struct.pack(">I", len(hdr)), hdr]
+    for b in blobs:
+        raw = np.ascontiguousarray(b).tobytes()
+        parts.append(struct.pack(">Q", len(raw)))
+        parts.append(raw)
+    payload = b"".join(parts)
+    sock.sendall(payload)
+    return len(payload)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Tuple[dict,
+                                                      List[np.ndarray]]]:
+    """Inverse of send_frame; None on clean EOF (sender shut down)."""
+    magic = _recv_exact(sock, 4)
+    if magic is None:
+        return None
+    if magic != MAGIC:
+        raise ConnectionError(f"bad page-stream magic {magic!r} "
+                              f"(framing desync)")
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    blobs = []
+    for meta in header.get("blobs", []):
+        (blen,) = struct.unpack(">Q", _recv_exact(sock, 8))
+        raw = _recv_exact(sock, blen)
+        blobs.append(np.frombuffer(raw, dtype=_np_dtype(meta["dtype"]))
+                     .reshape(meta["shape"]))
+    return header, blobs
+
+
+def _flatten_kv(k, v) -> List[np.ndarray]:
+    if isinstance(k, tuple):                      # int8 (codes, scales)
+        return [k[0], k[1], v[0], v[1]]
+    return [k, v]
+
+
+def _unflatten_kv(kind: str, blobs: List[np.ndarray]):
+    if kind == "int8":
+        return (blobs[0], blobs[1]), (blobs[2], blobs[3])
+    return blobs[0], blobs[1]
+
+
+def send_handoff(sock: socket.socket, h: dict, k, v, kv_dtype,
+                 page_size: int, ctx=None, clock=time.monotonic) -> int:
+    """Ship one staged PagedEngine handoff (engine.export_handoff
+    payload) as a frame; returns bytes sent. `ctx` is the prefill-side
+    RequestTracer.export_context — the decode engine continues the
+    trace from it. submit_t/first_token_t ride along for IN-PROCESS
+    receivers (same clock domain: bench's TTFT spans the full disagg
+    path); cross-host receivers must drop them."""
+    req = h["req"]
+    blobs = _flatten_kv(k, v)
+    header = {
+        "rid": req.rid, "prompt": list(req.prompt),
+        "tokens": list(req.tokens), "max_new": req.max_new,
+        "seed": req.seed, "tenant": req.tenant,
+        "slo_class": req.slo_class, "arrival": req.arrival,
+        "submit_t": req.submit_t, "first_token_t": req.first_token_t,
+        "first": int(h["first"]), "n_tokens": int(h["n_tokens"]),
+        "pages": len(h["pages"]), "page_size": int(page_size),
+        "kv": kv_dtype or "native",
+        "trace_ctx": ctx.to_wire() if ctx is not None else None,
+        "t_send": clock(),
+        "blobs": [{"dtype": b.dtype.name, "shape": list(b.shape)}
+                  for b in blobs],
+    }
+    return send_frame(sock, header, blobs)
+
+
+def recv_handoff(sock: socket.socket):
+    """Receive one handoff; returns (req, first, k, v, header) with a
+    freshly built Request carrying the wire trace context, or None on
+    clean EOF."""
+    got = recv_frame(sock)
+    if got is None:
+        return None
+    header, blobs = got
+    req = Request(rid=int(header["rid"]), prompt=list(header["prompt"]),
+                  max_new=int(header["max_new"]),
+                  seed=int(header["seed"]), arrival=header["arrival"],
+                  tenant=header["tenant"], slo_class=header["slo_class"],
+                  trace_ctx=header.get("trace_ctx"))
+    req.tokens = list(header.get("tokens", ()))
+    req.submit_t = header.get("submit_t")
+    req.first_token_t = header.get("first_token_t")
+    k, v = _unflatten_kv(header["kv"], blobs)
+    return req, int(header["first"]), k, v, header
+
+
+def run_disaggregated(prefill: PagedEngine, decode: PagedEngine,
+                      requests: List[Request], clock=time.monotonic,
+                      sleep=time.sleep, poll_s: float = 0.0005) -> dict:
+    """Drive a prefill_only engine and a decode engine joined by a
+    socketpair page stream until every request completes. Three strands:
+    the prefill thread steps its engine and streams staged handoffs, a
+    receiver thread drains frames into an inbox, and the caller's thread
+    admits + decodes (admission backpressure — no free slot or dry pool
+    — just parks the handoff until decode retires something).
+
+    Returns {completed, transfers, wall_s, bytes_per_request,
+    transfer_ms_p50/p95}: `transfers` has one {rid, pages, bytes,
+    send_ms, transfer_ms} per handoff, transfer_ms measured export-side
+    send start -> decode-side admit on the shared in-process clock."""
+    if prefill.pool.kv_dtype != decode.pool.kv_dtype:
+        raise ValueError(
+            f"kv_dtype mismatch across the stream: prefill side "
+            f"{prefill.pool.kv_dtype or 'native'}, decode side "
+            f"{decode.pool.kv_dtype or 'native'}")
+    if prefill.page_size != decode.page_size:
+        raise ValueError(
+            f"page_size mismatch across the stream: {prefill.page_size} "
+            f"vs {decode.page_size} (pages are the transfer unit)")
+    a, b = socket.socketpair()
+    transfers: List[dict] = []
+    inbox: deque = deque()
+    eof = threading.Event()
+    errors: List[BaseException] = []
+    t0 = clock()
+
+    def prefill_side():
+        try:
+            for req in sorted(requests, key=lambda r: r.arrival):
+                prefill.submit(req)
+            while prefill.has_work() or prefill.handoffs:
+                prefill.step()
+                while prefill.handoffs:
+                    h = prefill.handoffs.popleft()
+                    k, v = prefill.export_handoff(h)
+                    ctx = (prefill.rt.export_context(h["req"], "handoff")
+                           if prefill.rt is not None else None)
+                    ts = clock()
+                    nbytes = send_handoff(a, h, k, v,
+                                          prefill.pool.kv_dtype,
+                                          prefill.page_size, ctx=ctx,
+                                          clock=clock)
+                    transfers.append({"rid": h["req"].rid,
+                                      "pages": len(h["pages"]),
+                                      "bytes": nbytes,
+                                      "send_ms": (clock() - ts) * 1e3})
+                    prefill.finish_handoff(h)
+        except BaseException as e:          # surfaced by the caller
+            errors.append(e)
+        finally:
+            try:
+                a.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass
+
+    def recv_side():
+        try:
+            while True:
+                item = recv_handoff(b)
+                if item is None:
+                    break
+                inbox.append((item, clock()))
+        except BaseException as e:
+            errors.append(e)
+        finally:
+            eof.set()
+
+    tp = threading.Thread(target=prefill_side, daemon=True)
+    tr = threading.Thread(target=recv_side, daemon=True)
+    tp.start()
+    tr.start()
+    pending: deque = deque()
+    completed: List[Request] = []
+    while (not eof.is_set() or inbox or pending or decode.has_work()):
+        if errors:
+            break
+        while inbox:
+            pending.append(inbox.popleft())
+        progressed = False
+        while pending:
+            (req, first, k, v, header), _ = pending[0]
+            try:
+                decode.admit_prefilled(req, k, v, first)
+            except (RuntimeError, PoolExhausted):
+                break                        # backpressure: decode first
+            pending.popleft()
+            progressed = True
+            by_rid = {t["rid"]: t for t in transfers}
+            rec = by_rid.get(req.rid)
+            if rec is not None and header.get("t_send") is not None:
+                rec["transfer_ms"] = (clock() - header["t_send"]) * 1e3
+            if req.finish_t is not None:     # completed at admit (eos)
+                completed.append(req)
+        if decode.has_work():
+            for req in decode.step():
+                completed.append(req)
+            progressed = True
+        if not progressed:
+            sleep(poll_s)
+    tp.join(timeout=30)
+    tr.join(timeout=30)
+    a.close()
+    b.close()
+    if errors:
+        raise errors[0]
+    # max_new == 0 requests complete on the prefill side without a handoff
+    completed.extend(prefill.completed)
+    wall = clock() - t0
+    byt = [t["bytes"] for t in transfers]
+    tms = sorted(t.get("transfer_ms", t["send_ms"]) for t in transfers)
+    pct = lambda q: (tms[min(len(tms) - 1,
+                             int(q * (len(tms) - 1)))] if tms else 0.0)
+    return {
+        "completed": completed,
+        "transfers": transfers,
+        "wall_s": wall,
+        "transferred_pages": sum(t["pages"] for t in transfers),
+        "transferred_bytes": sum(byt),
+        "bytes_per_request": (sum(byt) / len(byt)) if byt else 0.0,
+        "transfer_ms_p50": round(pct(0.50), 3),
+        "transfer_ms_p95": round(pct(0.95), 3),
+    }

@@ -1,0 +1,88 @@
+#!/bin/bash
+# Round-20 serving fleet session (ISSUE 19): the prefix-aware router +
+# disaggregated prefill/decode on real chips. CI pins token identity
+# (fleet == single engine, disagg == colocated, native + int8) and the
+# dispatch laws on the CPU mesh; this window lands the NUMBERS the
+# design claims — fleet throughput vs the equal-chip single engine,
+# disagg-vs-colocated TTFT/TPOT at p95, and the KV wire priced in
+# bytes actually moved:
+#   1. static + trace preflight — graftcheck layer 1 AND layer 2 on the
+#      session's own jaxlib.
+#   2. the live 2-replica fleet — serve_fleet drives the router front
+#      door end to end (poisson arrivals, 2 tenants, shared prefixes so
+#      the shadow index has something to predict); per-replica obs
+#      streams land under $R/serve_logs_fleet for the obs_top fold.
+#   3. the single-replica baseline — same replica shape, half the
+#      fleet, same traffic; the router's win has to show up against
+#      this line, not against air.
+#   4. the disaggregated arm — prefill tp 2 streaming KV pages to a
+#      tp 1 decode engine (the resharding path), then the same wire at
+#      int8 (codes + scales framed per page).
+#   5. the bench A/B — bench --fleet runs all four arms in-process
+#      (fleet, equal-chip single, disagg, colocated) and emits one
+#      record; the int8 line is ONE knob apart.
+#   6. the gate — the int8 fleet record gated against the native one:
+#      fleet_tokens_per_sec/disagg_vs_colocated in band, transfer and
+#      dispatch p95 directional (25% — the wire is allowed its cost,
+#      not a collapse).
+# Idempotent; reuses the round-5 session helpers.
+set -u
+set -o pipefail
+cd /root/repo
+R=runs/r20
+M=$R/session_manifest.jsonl
+mkdir -p "$R"
+. runs/r5/session_lib.sh || { echo "session_lib.sh missing" >&2; exit 96; }
+echo "=== r20 fleet pass $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"
+
+step probe 120 python -c "import jax; d=jax.devices(); assert d[0].platform != 'cpu', d" \
+  || exit 17
+
+# 1. static sweep + the traced contracts
+step graftcheck 600 python scripts/graftcheck.py --json runs/r20/graftcheck.json
+
+# 2. the live 2-replica fleet (router at proc 0, replicas at proc 1/2;
+# shared prefixes a page wide so the shadow index earns its keep)
+step fleet2 1500 python scripts/serve_fleet.py --replicas 2 --tp_size 2 \
+  --model flagship-45m --random_init --slots 8 --page_size 64 \
+  --num_requests 48 --arrival poisson --rate 8 \
+  --prompt_len_min 16 --prompt_len_max 64 --max_new_tokens 64 \
+  --tenants 2 --shared_prefix_len 64 --trace_requests \
+  --log_dir runs/r20/serve_logs_fleet
+
+# 3. the single-replica baseline: same replica shape, same traffic
+step single2 1200 python scripts/serve_fleet.py --replicas 1 --tp_size 2 \
+  --model flagship-45m --random_init --slots 8 --page_size 64 \
+  --num_requests 48 --arrival poisson --rate 8 \
+  --prompt_len_min 16 --prompt_len_max 64 --max_new_tokens 64 \
+  --tenants 2 --shared_prefix_len 64 \
+  --log_dir runs/r20/serve_logs_single
+
+# 4. disaggregation: prefill tp 2 -> decode tp 1 (heads reshard on the
+# wire), native then int8 (codes + scales framed per page)
+step disagg 1200 python scripts/serve_fleet.py --disagg --prefill_tp 2 \
+  --tp_size 1 --model flagship-45m --random_init --slots 8 --page_size 64 \
+  --num_requests 24 --arrival burst \
+  --prompt_len_min 16 --prompt_len_max 64 --max_new_tokens 64 \
+  --trace_requests --log_dir runs/r20/serve_logs_disagg
+
+step disagg_int8 1200 python scripts/serve_fleet.py --disagg --prefill_tp 2 \
+  --tp_size 1 --kv_dtype int8 --model flagship-45m --random_init \
+  --slots 8 --page_size 64 --num_requests 24 --arrival burst \
+  --prompt_len_min 16 --prompt_len_max 64 --max_new_tokens 64 \
+  --log_dir runs/r20/serve_logs_disagg_int8
+
+# 5. the bench A/B: four arms in one record (fleet / equal-chip single
+# / disagg / colocated); the int8 line is ONE knob apart
+bench_line fleet 2400 --fleet --fleet_replicas 2 --model 45m --page_size 64 --slots 8 --serve_requests 24 --prompt_len 64 --gen_tokens 128
+bench_line fleetint8 2400 --fleet --fleet_replicas 2 --kv_dtype int8 --model 45m --page_size 64 --slots 8 --serve_requests 24 --prompt_len 64 --gen_tokens 128
+
+# 6. the gate: int8 fleet vs native — throughput/ratio fields in band,
+# transfer_ms_p95 and dispatch_ms_p95 allowed 25%, not a collapse
+step gate 240 python scripts/check_bench_regression.py --fresh runs/r20/bench_fleetint8.json --baseline runs/r20/bench_fleet.json --tol_latency_pct 25 --explain
+
+# fold the per-replica obs streams once for the session log
+step obstop 240 python scripts/obs_top.py runs/r20/serve_logs_fleet --once --no_clear
+
+python scripts/summarize_run.py "$R" || true
+echo "=== r20 fleet done $(date -u +%FT%TZ) ===" | tee -a "$R/session.log"

@@ -56,12 +56,20 @@ def _forensics():
 # per-unit below. Tolerances are fractions of the baseline.
 LOWER_BETTER_UNITS = ("ms/step", "ms/step (analytic)")
 THROUGHPUT_FIELDS = ("value", "vs_baseline", "paged_vs_slot",
-                     "accepted_tokens_per_dispatch")
+                     "accepted_tokens_per_dispatch",
+                     # serving fleet (ISSUE 19): the fleet headline, the
+                     # scalar floor of the per-class SLO table, and the
+                     # disagg A/B are all bigger-is-better
+                     "fleet_tokens_per_sec", "fleet_slo_attainment_min",
+                     "disagg_vs_colocated")
 # prefill_ms_per_token (ISSUE 18) is the long-context cp serving number:
 # the ring schedule exists to hold it flat-or-better while per-chip KV
 # bytes shrink 1/cp, so a record where it GREW vs the trajectory means
 # the ring (or its chunking) regressed, whatever tokens/s measured
-LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95", "prefill_ms_per_token")
+LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95", "prefill_ms_per_token",
+                  # fleet (ISSUE 19): a grown page-stream tail or router
+                  # hop is a regression whatever tokens/s measured
+                  "transfer_ms_p95", "dispatch_ms_p95")
 # analytic decode-dispatch HBM traffic (ISSUE 14): strictly directional —
 # a serving record whose per-step bytes GREW vs the trajectory regressed
 # the decode roofline (e.g. the pallas arm silently fell back to gather,

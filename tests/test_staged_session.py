@@ -44,7 +44,11 @@ R5 = os.path.join(REPO, "runs", "r5")
 # passes,
 # r19 long-context cp serving: traced cp-contract preflight, the
 # cp{1,2} A/B one knob apart, the 32k-token-prompt capacity arm, the
-# int8-KV cp arm, and the cp2-vs-cp1 regression-gate line)
+# int8-KV cp arm, and the cp2-vs-cp1 regression-gate line,
+# r20 the serving fleet: the live 2-replica router arm + its
+# single-replica baseline, the disaggregated prefill->decode arms
+# (native + int8 wire), the four-arm bench --fleet A/B, and the
+# int8-vs-native fleet regression-gate line)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -58,7 +62,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r16"),
                             os.path.join(REPO, "runs", "r17"),
                             os.path.join(REPO, "runs", "r18"),
-                            os.path.join(REPO, "runs", "r19"))
+                            os.path.join(REPO, "runs", "r19"),
+                            os.path.join(REPO, "runs", "r20"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
@@ -203,7 +208,7 @@ def validate(argv):
         name = os.path.basename(prog)[:-3]
         if name in ("tpu_checks", "make_image_corpus", "tune_flash_blocks",
                     "check_bench_regression", "graftcheck", "obs_top",
-                    "obs_diff"):
+                    "obs_diff", "serve_fleet"):
             mod = _load_script(name)
             return _parse_with(mod.parse_args, rest)
         if name == "run_step":

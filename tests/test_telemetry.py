@@ -313,6 +313,50 @@ def test_obs_top_once_renders_and_emits(tmp_path, capsys):
     assert os.path.exists(tmp_path / "fleet_rollup.jsonl")
 
 
+def test_collector_bounds_hung_endpoint_and_counts_it(tmp_path):
+    """Scrape liveness (ISSUE 19): an endpoint that ACCEPTS but never
+    responds must not hang the poll loop — the scrape is bounded by
+    `scrape_timeout` and the proc counts as unresponsive (mirroring the
+    hbm rollup's procs_unavailable: loud, never a folded zero)."""
+    import threading
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    held = []
+
+    def hold_open():
+        try:
+            conn, _ = srv.accept()
+            held.append(conn)               # never respond, never close
+        except OSError:
+            pass
+
+    threading.Thread(target=hold_open, daemon=True).start()
+    try:
+        c = FleetCollector(
+            [str(tmp_path)],
+            endpoints=[f"http://127.0.0.1:{port}/metrics.json"],
+            scrape_timeout=0.2)
+        t0 = time.monotonic()
+        c.poll()
+        assert time.monotonic() - t0 < 2.0  # bounded, not hung
+        assert c.procs_unresponsive == 1
+        assert c.unresponsive_scrapes == 1
+        assert c.rollup()["procs_unresponsive"] == 1
+        c.poll()                            # still hung: cumulative grows
+        assert c.procs_unresponsive == 1
+        assert c.unresponsive_scrapes == 2
+    finally:
+        for conn in held:
+            conn.close()
+        srv.close()
+    with pytest.raises(ValueError):
+        FleetCollector([str(tmp_path)], endpoints=["http://x"],
+                       scrape_timeout=0.0)
+
+
 # ------------------------------------- cross-process waterfall (tentpole)
 
 class _FakeReq:
