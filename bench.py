@@ -303,6 +303,18 @@ def parse_args(argv=None):
     p.add_argument("--fleet_replicas", type=int, default=2,
                    help="--fleet: replicas behind the router (the equal-"
                         "HBM baseline gets slots x this)")
+    p.add_argument("--reshard", action="store_true",
+                   help="bench the RESHARD pass (ISSUE 20): save one "
+                        "stamped checkpoint at the CURRENT layout (dp x "
+                        "tp at --zero), reshard it file->file onto "
+                        "--reshard_tp, validate the output shard set, and "
+                        "record reshard_ms / reshard_bytes_moved / plan "
+                        "op counts / peak host bytes (bounded by the "
+                        "largest single leaf, asserted). Two identical "
+                        "lines gate each other via check_bench_regression")
+    p.add_argument("--reshard_tp", type=int, default=0,
+                   help="--reshard: target tp width (default "
+                        "max(1, tp // 2))")
     args = p.parse_args(argv)
     if args.serving and (args.decode or args.breakdown):
         p.error("--serving excludes --decode/--breakdown")
@@ -315,6 +327,19 @@ def parse_args(argv=None):
     if args.fleet and args.cp > 1:
         p.error("--fleet composes with cp inside each replica via "
                 "--serving --cp; the fleet A/B keeps replicas cp=1")
+    if args.reshard and (args.serving or args.decode or args.breakdown
+                         or args.fleet):
+        p.error("--reshard excludes --serving/--decode/--breakdown/"
+                "--fleet (it benches the checkpoint redistribution pass, "
+                "not a model program)")
+    if args.reshard and args.cp > 1:
+        p.error("--reshard keeps cp=1 (checkpoint layouts stamp dp/tp; "
+                "cp is a serving-time axis)")
+    if args.reshard_tp and not args.reshard:
+        p.error("--reshard_tp is a --reshard knob")
+    if args.reshard and args.reshard_tp < 0:
+        p.error(f"--reshard_tp must be >= 0 (0 = tp // 2), got "
+                f"{args.reshard_tp}")
     if args.speculate and not args.serving:
         p.error("--speculate is a --serving mode")
     if args.kv_dtype != "native" and not (args.serving or args.fleet):
@@ -1686,6 +1711,74 @@ def _discover_backend(probe=None, timeout_s=240.0, stamp=None):
     return result["n"]
 
 
+def run_reshard_bench(args, mesh, cfg, tp: int) -> None:
+    """Reshard pass timing (ISSUE 20): save one stamped checkpoint at the
+    current layout (dp x tp at --zero, moments included), reshard it
+    file->file onto --reshard_tp, and record the plan + movement facts.
+    The streamed executor's law is ASSERTED here too: peak host bytes
+    never exceed the largest single leaf. Two identical invocations gate
+    each other in CI through check_bench_regression's reshard_ms
+    (latency-directional) and reshard_bytes_moved (bytes-directional)
+    fields."""
+    import shutil
+    import tempfile
+
+    from distributed_pytorch_from_scratch_tpu.reshard import (
+        HostMeter, make_layout, reshard_checkpoint)
+    from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+        save_checkpoint, validate_checkpoint)
+    from distributed_pytorch_from_scratch_tpu.training.zero import (
+        zero3_shardings)
+
+    dst_tp = args.reshard_tp or max(1, tp // 2)
+    model = Transformer(cfg, tp_size=tp,
+                        sequence_parallel=args.sequence_parallel and tp > 1)
+    sh = (zero3_shardings(model, mesh) if args.zero >= 3
+          else model.shardings(mesh))
+    params = jax.device_put(model.init(jax.random.key(0)), sh)
+    opt = init_adam_state(params)
+    work = tempfile.mkdtemp(prefix="bench_reshard_")
+    try:
+        src = os.path.join(work, "src")
+        save_checkpoint(src, 0, 0.0, model.to_canonical(params),
+                        model.canonical_specs(), tp, opt_state=opt,
+                        zero_stage=args.zero, mesh_axes=mesh)
+        dst_layout = make_layout((("tp", dst_tp),),
+                                 model.canonical_specs(), zero_stage=0)
+        meter = HostMeter()
+        echo = lambda *a: print("bench[reshard]:", *a, file=sys.stderr)
+        t0 = time.perf_counter()
+        paths, plan, info = reshard_checkpoint(
+            src, 0, os.path.join(work, "dst"), dst_layout, meter=meter,
+            echo=echo)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        tp_out, _ = validate_checkpoint(os.path.join(work, "dst"), 0)
+        assert tp_out == dst_tp, (tp_out, dst_tp)
+        assert meter.peak <= info["max_leaf_bytes"], \
+            (meter.peak, info["max_leaf_bytes"])
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    print(f"bench[reshard {args.model}]: {info['src']} -> {info['dst']}, "
+          f"{len(paths)} shard(s), {info['bytes_moved']} B moved "
+          f"({info['ops']}), peak host {meter.peak} B <= largest leaf "
+          f"{info['max_leaf_bytes']} B, {wall_ms:.0f} ms", file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"reshard wall ms ({args.model}, {info['src']} -> "
+                   f"{info['dst']}, moments included, streamed "
+                   f"leaf-at-a-time)"),
+        "value": round(wall_ms, 1),
+        "unit": "ms",
+        "reshard_ms": round(wall_ms, 1),
+        "reshard_bytes_moved": info["bytes_moved"],
+        "plan_ops": info["ops"],
+        "n_leaves": info["n_leaves"],
+        "peak_host_bytes": meter.peak,
+        "max_leaf_bytes": info["max_leaf_bytes"],
+        "files": len(paths),
+        **run_stamp(vars(args)),
+    }))
+
+
 def main(argv=None):
     args = parse_args(argv)
     try:
@@ -1728,10 +1821,13 @@ def main(argv=None):
                                   args.seqlen or cfg.maxlen,
                                   tp=tp, world=args.dp * tp,
                                   zero_stage=args.zero, dp=args.dp)
-    if args.decode or args.breakdown or args.serving or args.fleet:
+    if (args.decode or args.breakdown or args.serving or args.fleet
+            or args.reshard):
         if args.introspect and (args.decode or args.serving or args.fleet):
             print("bench: --introspect does not apply to --decode/"
                   "--serving/--fleet; ignoring it", file=sys.stderr)
+        if args.reshard:
+            return run_reshard_bench(args, mesh, cfg, tp)
         if args.fleet:
             return run_fleet_bench(args, mesh, cfg, tp)
         if args.serving:

@@ -344,6 +344,49 @@ def check_zero3_no_whole_tree_gather(prog) -> dict:
         f"per-layer gathers + their reduce-scatter transposes)", prog.name)
 
 
+def check_reshard_fragmentwise(prog) -> dict:
+    """Reshard redistribution (ISSUE 20): the lowered live-mesh reshard
+    must move leaves FRAGMENT-WISE, matching the planner's schedule.
+    Pins: (a) every wire-carrying collective is a dp all-gather (the
+    per-leaf un-ZeRO gather — nothing else belongs on this wire); (b)
+    the gather COUNT equals the planner's gather-leaf count (XLA fusing
+    leaves into one whole-tree gather collapses the count); (c) no
+    single payload exceeds one leaf's bytes — the device-side mirror of
+    the streamed host path's peak-one-leaf bound."""
+    colls = parse_collectives_by_axis(prog.compiled_text, prog.mesh)
+    wire = [c for c in colls if c.axis != "local"]
+    gathers = [c for c in wire if (c.axis, c.op) == ("dp", "all-gather")]
+    alien = [c for c in wire if (c.axis, c.op) != ("dp", "all-gather")]
+    want = int(prog.config["plan_gather_leaves"])
+    cap = int(prog.config["max_leaf_bytes"])
+    problems = []
+    if alien:
+        worst = max(alien, key=lambda c: c.bytes)
+        problems.append(
+            f"{len(alien)} collective(s) besides the per-leaf dp "
+            f"all-gather (largest: {worst.op} on {worst.axis}, "
+            f"{worst.bytes}B) — the redistribution wire must carry "
+            f"nothing else")
+    if len(gathers) != want:
+        problems.append(
+            f"{len(gathers)} dp all-gather(s) vs {want} gather leaves "
+            f"in the planned schedule — the lowered pass no longer "
+            f"matches reshard/plan.py fragment-wise")
+    big = [c for c in gathers if c.bytes > cap]
+    if big:
+        worst = max(big, key=lambda c: c.bytes)
+        problems.append(
+            f"gather payload {worst.bytes}B exceeds the largest leaf "
+            f"({cap}B) — leaves are fusing into a whole-tree gather")
+    detail = ("; ".join(problems) if problems else
+              f"{len(gathers)} per-leaf dp all-gather(s) == planned "
+              f"gather leaves; largest payload "
+              f"{max((c.bytes for c in gathers), default=0)}B <= one "
+              f"leaf ({cap}B); no other wire collective")
+    return _result("reshard-fragmentwise", not problems, detail,
+                   prog.name)
+
+
 _ALIAS_ENTRY = re.compile(r"\{[\d,\s]*\}:\s*\((\d+),")
 
 
@@ -480,6 +523,11 @@ def run_trace_contracts(full: bool = False) -> List[dict]:
         results.append(check_collective_inventory(prog, _expected(prog)))
         results.append(check_donation_aliased(prog))
         results.append(check_cp_no_page_gather(prog))
+
+    # the reshard redistribution pass (ISSUE 20) rides the DEFAULT set:
+    # the lowered live-mesh reshard must match reshard/plan.py's
+    # fragment-wise schedule (per-leaf gathers, one-leaf payload bound)
+    results.append(check_reshard_fragmentwise(P.reshard_program()))
 
     if full:
         for impl in ("gather", "pallas"):

@@ -317,6 +317,69 @@ def check_controller_discipline(src: SourceFile) -> List[Violation]:
     return out
 
 
+# ----------------------------------------------- host-gather-in-reshard --
+
+@rule("host-gather-in-reshard",
+      "whole-tree host materialisation on a reshard path",
+      "the reshard subsystem's (ISSUE 20) one law: leaves cross the host "
+      "ONE AT A TIME, peak host bytes bounded by the largest single leaf "
+      "— a 45M-param tree that fits sharded on 8 chips does not fit "
+      "unsharded in one host buffer. A whole-tree jax.device_get or an "
+      "eager dict(np.load(...)) on a reshard path is exactly the "
+      "one-shot materialisation reshard/apply.py's streaming executors "
+      "exist to eliminate")
+def check_host_gather_in_reshard(src: SourceFile) -> List[Violation]:
+    path = src.path.replace(os.sep, "/")
+    if "/reshard/" in path or path.startswith("reshard/"):
+        scoped = list(src.nodes)
+    else:
+        # outside the subsystem the rule guards functions that CLAIM to
+        # reshard (serve_fleet's restart, train's elastic resume, bench)
+        scoped, seen = [], set()
+        for node in src.nodes:
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and "reshard" in node.name):
+                for sub in ast.walk(node):
+                    if id(sub) not in seen:
+                        seen.add(id(sub))
+                        scoped.append(sub)
+    if not scoped:
+        return []
+    # device_get inside a Lambda is the streamed per-leaf idiom (a
+    # jax.tree.map leaf callback) — the tree-at-once call is the hazard
+    in_lambda = set()
+    for node in scoped:
+        if isinstance(node, ast.Lambda):
+            for sub in ast.walk(node):
+                in_lambda.add(id(sub))
+    out: List[Violation] = []
+    for node in scoped:
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if (name.split(".")[-1] == "device_get"
+                and id(node) not in in_lambda):
+            out.append(Violation(
+                "host-gather-in-reshard", src.path, node.lineno,
+                "whole-tree jax.device_get on a reshard path — stream "
+                "leaves one at a time (a per-leaf tree.map callback, or "
+                "reshard/apply.py's executors); peak host bytes must "
+                "stay bounded by the largest single leaf"))
+        if (isinstance(node.func, ast.Name) and node.func.id == "dict"
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Call)):
+            inner = dotted(node.args[0].func) or ""
+            if (inner.split(".")[-1] == "load"
+                    and inner.split(".")[0] in ("np", "numpy")):
+                out.append(Violation(
+                    "host-gather-in-reshard", src.path, node.lineno,
+                    "dict(np.load(...)) materialises every shard member "
+                    "at once on a reshard path — read members lazily "
+                    "(NpzFile is lazy per key; reshard/apply.py streams "
+                    "payload bytes member-by-member)"))
+    return out
+
+
 # ---------------------------------------------------------- lock-discipline --
 
 _LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",

@@ -281,7 +281,64 @@ def speculative_verify_program(tp: int = 2, k: int = 2,
                    (1, 2), cfg)
 
 
+@functools.lru_cache(maxsize=4)
+def reshard_program(dp: int = 2, tp: int = 2) -> Program:
+    """The live-mesh redistribution pass reshard/ lowers when source and
+    target layouts coexist on one device set: an identity jit from the
+    ZeRO-3 training layout (params dp-sharded leaf-wise) onto the
+    serving layout (dp-replicated, tp kept). XLA lowers this to one dp
+    all-gather PER LEAF — the fragment-wise schedule reshard/plan.py
+    plans — and the config carries the planner's own numbers for the
+    same leaf set (`plan_gather_leaves`, `max_leaf_bytes`) so
+    `check_reshard_fragmentwise` can pin lowered reality against the
+    planned schedule: same gather count, no payload beyond one leaf. A
+    whole-tree gather (the host path's forbidden materialisation,
+    transplanted to devices) would collapse the count and blow the
+    payload bound."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..config import MeshConfig
+    from ..models.transformer import Transformer
+    from ..reshard import make_layout
+    from ..reshard.plan import plan_reshard
+    from ..runtime.mesh import make_mesh
+    from ..training.checkpoint import _flatten
+    from ..training.zero import zero3_shardings
+
+    cfg = _tiny_model_cfg()
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    model = Transformer(cfg, tp_size=tp, sequence_parallel=(tp > 1),
+                        remat="dots")
+    params = jax.device_put(model.init(jax.random.key(3)),
+                            zero3_shardings(model, mesh))
+    dst_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), model.specs(),
+                          is_leaf=lambda x: isinstance(x, PartitionSpec))
+    fn = jax.jit(lambda t: t, out_shardings=dst_sh)
+    lowered = fn.lower(params)
+    compiled = lowered.compile()
+    # the planner's schedule for the SAME leaf set: src = stamped zero-3
+    # layout, dst = the serving layout (zero 0, same specs, same mesh)
+    flat = _flatten(params, "param")
+    shapes = {k: tuple(v.shape) for k, v in flat.items()}
+    items = {k: v.dtype.itemsize for k, v in flat.items()}
+    specs = model.canonical_specs()
+    plan = plan_reshard(sorted(flat), shapes, items,
+                        make_layout(mesh, specs, zero_stage=3),
+                        make_layout(mesh, specs, zero_stage=0))
+    gathers = sum(1 for lp in plan.leaves.values() if lp.op == "gather")
+    return Program(
+        name=f"reshard_dp{dp}tp{tp}_zero3_to_serving",
+        lowered_text=lowered.as_text(),
+        compiled_text=compiled.as_text(),
+        mesh=mesh, donated_leaves=0,
+        donated_flat_start=0, donated_flat_stop=0,
+        config=dict(reshard=True, plan_gather_leaves=gathers,
+                    max_leaf_bytes=plan.summary()["max_leaf_bytes"]))
+
+
 def clear_caches() -> None:
     for fn in (train_step_program, _paged_engine, paged_decode_program,
-               prefill_chunk_program, speculative_verify_program):
+               prefill_chunk_program, speculative_verify_program,
+               reshard_program):
         fn.cache_clear()

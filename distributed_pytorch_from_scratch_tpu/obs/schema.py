@@ -32,8 +32,11 @@ from typing import Dict, List, Tuple
 # Version 6 = the ISSUE-17 run-forensics family: run_card (the archive
 # index's normalized per-run summary) and run_diff (the pairwise
 # forensic report obs_diff / check_bench_regression --explain emit).
+# Version 7 = the ISSUE-20 elastic-reshard family: reshard_event (one
+# any-layout->any-layout redistribution — elastic resume, fleet replica
+# restart at a new width, or the offline CLI — with its plan summary).
 # (Version 1 is retroactively "any pre-versioned event".)
-EVENT_SCHEMA_VERSION = 6
+EVENT_SCHEMA_VERSION = 7
 
 # tag -> fields a consumer may key on (presence contract, not types).
 # Only EVENT tags appear here — scalar ({"tag", "value", "step"}) and text
@@ -95,6 +98,13 @@ EVENT_REQUIRED: Dict[str, Tuple[str, ...]] = {
     # one pairwise forensic report (obs/rundiff.py): the config delta
     # joined to its measured consequences, with the ranked suspects list
     "run_diff": ("run_a", "run_b", "config_delta", "suspects"),
+    # -- ISSUE 20: the elastic-reshard family ----------------------------
+    # one layout redistribution (reshard/): the source and target layout
+    # signatures, the bytes the plan actually moved, the per-op schedule
+    # counts, and the wall time — forensics joins this into run lineage
+    # ("this run's params came from THAT layout")
+    "reshard_event": ("src_layout", "dst_layout", "bytes_moved",
+                      "plan_ops", "wall_ms"),
 }
 
 

@@ -1,0 +1,33 @@
+"""reshard/ — mesh-elastic checkpoints + any-layout→any-layout
+redistribution (ISSUE 20).
+
+Three layers:
+
+* `layout` — the Layout record (mesh axes + per-leaf canonical
+  PartitionSpec + ZeRO stage) that `save_checkpoint` stamps into every
+  shard and the planner consumes; legacy unstamped checkpoints resolve
+  through a loud filename-inference path, never a crash.
+* `plan` — the redistribution pass: per-leaf fragment schedules (the
+  interval intersections of the source and target shard grids) plus the
+  device-op classification (copy / gather / slice / permute) whose
+  inventory the graftcheck layer-2 contract pins.
+* `apply` — the executors: a STREAMED host path (leaf-at-a-time, peak
+  host bytes bounded by one leaf + one source shard, metered and
+  asserted in tests — never the one-shot full-tree materialisation the
+  "host-gather-in-reshard" lint forbids) for file→file and file→device,
+  and a per-leaf `device_put` path for live params (fleet replica
+  restarts at a new tp width).
+"""
+
+from .layout import (LAYOUT_KEY, Layout, layouts_equal, make_layout,
+                     read_stamp, resolve_source_layout)
+from .plan import LeafPlan, ReshardError, ReshardPlan, plan_reshard
+from .apply import (HostMeter, plan_checkpoint, reshard_checkpoint,
+                    reshard_params, stream_load)
+
+__all__ = [
+    "LAYOUT_KEY", "Layout", "layouts_equal", "make_layout", "read_stamp",
+    "resolve_source_layout", "LeafPlan", "ReshardError", "ReshardPlan",
+    "plan_reshard", "HostMeter", "plan_checkpoint", "reshard_checkpoint",
+    "reshard_params", "stream_load",
+]

@@ -1,0 +1,212 @@
+"""Layout records + checkpoint layout stamping (reshard subsystem).
+
+A `Layout` is everything the redistribution planner needs to know about
+where a checkpoint's tree LIVED: the mesh axes that were larger than 1,
+the per-leaf canonical PartitionSpec (flat-keyed exactly like the
+checkpoint's npz members — ``param/embedding/weight``), and the ZeRO
+stage. The stage is carried separately from the specs on purpose: on
+disk every shard holds GLOBAL values sliced only along its tp dim, and
+the dp extension ZeRO applies is a DEVICE-layout fact derived from the
+same one rule everywhere (`training/zero._zero_dim`) — stamping the
+derived specs too would let the two drift.
+
+`save_checkpoint` serialises a Layout into each shard under
+``__layout__`` (a JSON string; `assemble` ignores any ``__``-prefixed
+member, so pre-ISSUE-20 readers skip it untouched). Legacy checkpoints
+without the stamp resolve through `resolve_source_layout`'s loud
+"layout inferred from filenames" note — the runindex legacy-record
+convention — never a crash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Optional, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+LAYOUT_KEY = "__layout__"
+LAYOUT_VERSION = 1
+
+
+def _flatten_specs(specs: Any, prefix: str = "param") -> Dict[str, P]:
+    """Canonical spec tree -> {checkpoint flat key: PartitionSpec}, the
+    same key derivation as `training/checkpoint._flatten` (specs are
+    pytrees of P leaves, so the flatten walks with is_leaf)."""
+    import jax
+    flat: Dict[str, P] = {}
+    pairs = jax.tree_util.tree_flatten_with_path(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    for path, leaf in pairs:
+        key = prefix + "".join(
+            f"/{p.key}" if hasattr(p, "key") else f"/{p.idx}" for p in path)
+        flat[key] = leaf
+    return flat
+
+
+def _spec_to_jsonable(spec: P) -> list:
+    out = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:                       # a tuple of axis names, e.g. ("dp","tp")
+            out.append(list(entry))
+    return out
+
+
+def _spec_from_jsonable(entries: list) -> P:
+    return P(*[tuple(e) if isinstance(e, list) else e for e in entries])
+
+
+@dataclasses.dataclass(frozen=True)
+class Layout:
+    """One checkpoint-producing (or -consuming) arrangement: mesh axes of
+    size > 1, flat canonical specs, and the ZeRO stage."""
+
+    mesh_axes: Tuple[Tuple[str, int], ...]
+    specs: Dict[str, P]             # "param/..." -> canonical PartitionSpec
+    zero_stage: int = 0
+
+    def axis_size(self, name: str) -> int:
+        for axis, size in self.mesh_axes:
+            if axis == name:
+                return size
+        return 1
+
+    @property
+    def tp(self) -> int:
+        return self.axis_size("tp")
+
+    @property
+    def dp(self) -> int:
+        return self.axis_size("dp")
+
+    def spec_for(self, key: str) -> P:
+        """Spec of any flat key — moments ride their param's spec (the
+        `save_checkpoint` rule: mu/nu shard exactly like param)."""
+        kind, _, rest = key.partition("/")
+        pkey = "param/" + rest if kind in ("mu", "nu") else key
+        try:
+            return self.specs[pkey]
+        except KeyError:
+            raise KeyError(f"no spec for checkpoint key {key!r} "
+                           f"(looked up {pkey!r})") from None
+
+    def describe(self) -> str:
+        axes = "x".join(f"{a}{s}" for a, s in self.mesh_axes) or "single"
+        return f"{axes} zero{self.zero_stage}"
+
+    def signature(self) -> tuple:
+        """Order-independent comparable form (mesh axis order is a mesh
+        construction detail, not a layout difference)."""
+        return (tuple(sorted(self.mesh_axes)), int(self.zero_stage),
+                tuple(sorted((k, tuple(_spec_to_jsonable(s)))
+                             for k, s in self.specs.items())))
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": LAYOUT_VERSION,
+            "mesh_axes": [[a, s] for a, s in self.mesh_axes],
+            "zero_stage": int(self.zero_stage),
+            "specs": {k: _spec_to_jsonable(s)
+                      for k, s in sorted(self.specs.items())},
+        })
+
+    @classmethod
+    def from_json(cls, text: str) -> "Layout":
+        d = json.loads(text)
+        if d.get("version", 0) > LAYOUT_VERSION:
+            raise ValueError(
+                f"checkpoint layout stamp is version {d['version']}; this "
+                f"reader understands <= {LAYOUT_VERSION} — update before "
+                f"resharding")
+        return cls(
+            mesh_axes=tuple((a, int(s)) for a, s in d["mesh_axes"]),
+            specs={k: _spec_from_jsonable(v)
+                   for k, v in d["specs"].items()},
+            zero_stage=int(d["zero_stage"]))
+
+
+def layouts_equal(a: Layout, b: Layout) -> bool:
+    return a.signature() == b.signature()
+
+
+def mesh_axes_of(mesh) -> Tuple[Tuple[str, int], ...]:
+    """(axis, size) pairs of a live Mesh, size-1 axes dropped (an unused
+    axis is a mesh-construction detail, not a layout fact)."""
+    return tuple((str(name), int(size))
+                 for name, size in zip(mesh.axis_names, mesh.devices.shape)
+                 if int(size) > 1)
+
+
+def make_layout(mesh_axes: Any, specs: Any, zero_stage: int = 0) -> Layout:
+    """Build a Layout from a live Mesh (or explicit (axis, size) pairs)
+    and a canonical spec TREE (`model.canonical_specs()`)."""
+    if hasattr(mesh_axes, "axis_names"):
+        mesh_axes = mesh_axes_of(mesh_axes)
+    else:
+        mesh_axes = tuple((a, int(s)) for a, s in mesh_axes if int(s) > 1)
+    flat = specs if isinstance(specs, dict) and all(
+        isinstance(k, str) and "/" in k for k in specs) else \
+        _flatten_specs(specs)
+    return Layout(mesh_axes=mesh_axes, specs=dict(flat),
+                  zero_stage=int(zero_stage))
+
+
+def stamp(shard: Dict[str, Any], layout: Layout) -> None:
+    """Add the layout stamp to one shard dict about to be npz-written."""
+    import numpy as np
+    shard[LAYOUT_KEY] = np.asarray(layout.to_json())
+
+
+def read_stamp(npz) -> Optional[Layout]:
+    """The Layout stamped into an open NpzFile (or shard dict), None when
+    the checkpoint predates the stamp."""
+    try:
+        member = npz[LAYOUT_KEY]
+    except KeyError:
+        return None
+    return Layout.from_json(str(member.item() if hasattr(member, "item")
+                                else member))
+
+
+def resolve_source_layout(ckpt_dir: str, step: int, specs: Any = None,
+                          ext: str = "npz",
+                          echo=print) -> Tuple[Layout, bool]:
+    """(source Layout, is_legacy) for a checkpoint on disk.
+
+    Stamped npz shards return their stamp verbatim. Anything else — a
+    pre-ISSUE-20 npz, or a torch ``.pth`` rank span (which has nowhere to
+    carry the stamp) — is LEGACY: the tp width comes from
+    `validate_checkpoint`'s filename/metadata logic, the zero stage from
+    ``__zero_stage__`` when present, and the specs must be supplied by
+    the caller (a model's `canonical_specs()`). Legacy resolution prints
+    a loud note and never crashes; only a legacy source with NO spec
+    source raises, naming the fix.
+    """
+    import numpy as np
+
+    from ..training.checkpoint import validate_checkpoint
+
+    tp_size, rank_files = validate_checkpoint(ckpt_dir, step, ext=ext)
+    zero_stage = 0
+    if ext == "npz":
+        with np.load(rank_files[min(rank_files)]) as npz:
+            stamped = read_stamp(npz)
+            if stamped is not None:
+                return stamped, False
+            try:
+                zero_stage = int(npz["__zero_stage__"])
+            except KeyError:
+                pass
+    if specs is None:
+        raise ValueError(
+            f"legacy checkpoint (no {LAYOUT_KEY} stamp) at {ckpt_dir} "
+            f"iter {step}: pass the model's canonical_specs() (CLI: "
+            f"--model <preset>) so the layout can be inferred")
+    echo(f"note: legacy checkpoint at {ckpt_dir} iter {step} — layout "
+         f"inferred from filenames (tp{tp_size}, zero{zero_stage}); "
+         f"re-save to stamp it")
+    return make_layout((("tp", tp_size),), specs,
+                       zero_stage=zero_stage), True

@@ -227,11 +227,16 @@ class FleetRouter:
         raise last_err if last_err is not None else QueueFull(
             "no replica accepted the request")
 
-    def replace_replica(self, name: str, engine) -> None:
+    def replace_replica(self, name: str, engine, reshard=None) -> None:
         """Attach a RESTARTED replica under an existing name. The shadow
         resets (a fresh process holds no pages) and sessions keep their
         pin — the name is the address, not the process. In-flight
-        requests on the old process are the caller's loss to re-submit."""
+        requests on the old process are the caller's loss to re-submit.
+
+        `reshard`: optional dict describing a heterogeneous restart (the
+        new engine serves a different layout — reshard/plan summary:
+        src/dst layouts, bytes moved, op counts); folded into the
+        replica_restart event so forensics sees width changes."""
         for i, (n, _) in enumerate(self.replicas):
             if n == name:
                 self.replicas[i] = (name, engine)
@@ -243,7 +248,8 @@ class FleetRouter:
             if rname == name:
                 del self._live[rid]
         if self.writer is not None:
-            self.writer.event("replica_restart", replica=name)
+            extra = {"reshard": reshard} if reshard else {}
+            self.writer.event("replica_restart", replica=name, **extra)
 
     # -- the fleet loop ---------------------------------------------------
     def step(self) -> List[Request]:

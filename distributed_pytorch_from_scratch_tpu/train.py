@@ -658,6 +658,36 @@ def train(args: argparse.Namespace) -> dict:
                 last = int(multihost_utils.broadcast_one_to_all(
                     np.int64(-1 if last is None else last)))
                 if last >= 0:
+                    # Elastic restarts are single-process only: detect a
+                    # layout mismatch on the loading process, agree on it
+                    # everywhere, and refuse LOUDLY with the offline fix
+                    # (a half-elastic broadcast would feed every process a
+                    # tree its mesh does not own).
+                    mismatch = 0
+                    if is_main:
+                        from .reshard import (layouts_equal, make_layout,
+                                              resolve_source_layout)
+                        src_lay, _ = resolve_source_layout(
+                            args.save_dir, last,
+                            specs=model.canonical_specs())
+                        dst_lay = make_layout(mesh, model.canonical_specs(),
+                                              zero_stage=zero_stage)
+                        mismatch = 0 if layouts_equal(src_lay, dst_lay) \
+                            else 1
+                    mismatch = int(multihost_utils.broadcast_one_to_all(
+                        np.int64(mismatch)))
+                    if mismatch:
+                        raise SystemExit(
+                            f"--resume mesh mismatch: the checkpoint at "
+                            f"{args.save_dir} iter {last} was saved under "
+                            f"a different layout than this "
+                            f"{nproc}-process run's mesh. In-process "
+                            f"elastic resharding is single-process only; "
+                            f"reshard the files offline first: python "
+                            f"scripts/reshard_ckpt.py --src "
+                            f"{args.save_dir} --dst <dir> --tp "
+                            f"{args.tp_size} --dp {args.dp_size} --zero "
+                            f"{zero_stage} --model <preset>")
                     tmpl_p = model.to_canonical(params)
                     tmpl_o = _map_moments(opt_state, model.to_canonical)
                     if is_main:
@@ -679,16 +709,74 @@ def train(args: argparse.Namespace) -> dict:
             else:
                 last = latest_step(args.save_dir)
                 if last is not None:
-                    with observer.span("checkpoint", "restore", step=last):
-                        params, opt_state, start_step = load_checkpoint(
-                            args.save_dir, last, model.to_canonical(params),
-                            model.canonical_specs(), with_opt=True)
-                    params = model.from_canonical(params)
-                    if opt_state is None:
-                        opt_state = init_adam_state(params)
+                    from .reshard import (layouts_equal, make_layout,
+                                          resolve_source_layout)
+                    src_lay, _ = resolve_source_layout(
+                        args.save_dir, last, specs=model.canonical_specs())
+                    dst_lay = make_layout(mesh, model.canonical_specs(),
+                                          zero_stage=zero_stage)
+                    if layouts_equal(src_lay, dst_lay):
+                        with observer.span("checkpoint", "restore",
+                                           step=last):
+                            params, opt_state, start_step = load_checkpoint(
+                                args.save_dir, last,
+                                model.to_canonical(params),
+                                model.canonical_specs(), with_opt=True)
+                        params = model.from_canonical(params)
+                        if opt_state is None:
+                            opt_state = init_adam_state(params)
+                        else:
+                            opt_state = _map_moments(opt_state,
+                                                     model.from_canonical)
+                        print(f"resumed from iter {start_step} in "
+                              f"{args.save_dir}")
                     else:
-                        opt_state = _map_moments(opt_state, model.from_canonical)
-                    print(f"resumed from iter {start_step} in {args.save_dir}")
+                        # ELASTIC restart: the checkpoint's mesh is not this
+                        # run's mesh. Route through the reshard plan — each
+                        # leaf stream-assembles once on the host and lands
+                        # directly on its TARGET sharding (ZeRO ownership
+                        # re-derives on this mesh via the same _zero_dim
+                        # rule the optimizer uses), then record the lineage
+                        # for run forensics.
+                        if model._interleaved:
+                            raise SystemExit(
+                                "--resume across meshes with interleaved "
+                                "pipeline stages is not supported: the "
+                                "on-device tree is a permutation of the "
+                                "canonical checkpoint tree (from_canonical "
+                                "is layout-dependent) — resume on the "
+                                "saving mesh, or use a non-interleaved "
+                                "schedule")
+                        from .reshard import HostMeter, stream_load
+                        if zero_stage >= 3:
+                            from .training.zero import zero3_shardings
+                            p_sh = zero3_shardings(model, mesh)
+                        else:
+                            p_sh = model.shardings(mesh)
+                        m_sh = (zero1_moment_shardings(model, mesh)
+                                if zero_stage in (1, 2) else p_sh)
+                        meter = HostMeter()
+                        with observer.span("checkpoint", "reshard_restore",
+                                           step=last):
+                            params, ck_o, start_step, info = stream_load(
+                                args.save_dir, last,
+                                model.to_canonical(params),
+                                model.canonical_specs(), dst_lay, p_sh,
+                                moment_shardings=m_sh, with_opt=True,
+                                meter=meter)
+                        opt_state = (ck_o if ck_o is not None
+                                     else init_adam_state(params))
+                        writer.event(
+                            "reshard_event", src_layout=info["src"],
+                            dst_layout=info["dst"],
+                            bytes_moved=info["bytes_moved"],
+                            plan_ops=info["ops"], wall_ms=info["wall_ms"],
+                            step=start_step,
+                            peak_host_bytes=meter.peak)
+                        print(f"elastic resume: iter {start_step} "
+                              f"resharded {info['src']} -> {info['dst']} "
+                              f"({info['bytes_moved']} bytes moved, "
+                              f"{info['wall_ms']} ms)")
 
         if zero_stage >= 3:
             # ZeRO-3: params REST dp-sharded (zero3_specs); the step's
@@ -952,7 +1040,7 @@ def train(args: argparse.Namespace) -> dict:
                 model.canonical_specs(), args.tp_size, save_opt,
                 reserve_last_n=args.reserve_last_n_ckpts,
                 async_write=True, tracer=observer.tracer,
-                zero_stage=zero_stage)
+                zero_stage=zero_stage, mesh_axes=mesh)
             last_saved = step
 
         def shutdown_save(step):

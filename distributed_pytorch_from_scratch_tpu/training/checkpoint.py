@@ -115,7 +115,8 @@ def save_checkpoint(save_dir: str, step: int, avg_loss: float, params: Any,
                     reserve_last_n: int = -1,
                     async_write: bool = False,
                     tracer=None,
-                    zero_stage: int = 0) -> "List[str] | AsyncSaveHandle":
+                    zero_stage: int = 0,
+                    mesh_axes=None) -> "List[str] | AsyncSaveHandle":
     """Write one npz per TP rank; returns the paths written.
 
     Works unchanged for ZeRO-sharded state (dp-sharded moments at stage
@@ -138,8 +139,19 @@ def save_checkpoint(save_dir: str, step: int, avg_loss: float, params: Any,
     `tracer`: optional obs.SpanTracer — the D2H+slice+write work records a
     "checkpoint_write" span on whichever thread performs it (the async
     writer shows up as its own track in the timeline).
+
+    `mesh_axes`: the saving mesh (a live Mesh, or (axis, size) pairs) for
+    the ``__layout__`` stamp — mesh shape + per-leaf PartitionSpec + zero
+    stage, everything the reshard planner needs to load this checkpoint
+    onto a DIFFERENT mesh (reshard/layout.py). Defaults to the tp-only
+    mesh the filename convention already implies; `assemble` skips
+    ``__``-prefixed members, so pre-stamp readers are unaffected.
     """
     os.makedirs(save_dir, exist_ok=True)
+    from ..reshard.layout import make_layout
+    layout = make_layout(mesh_axes if mesh_axes is not None
+                         else (("tp", tp_size),), specs,
+                         zero_stage=zero_stage)
 
     def write(params, opt_state) -> List[str]:
         t0 = tracer.now() if tracer is not None else None
@@ -173,6 +185,7 @@ def save_checkpoint(save_dir: str, step: int, avg_loss: float, params: Any,
             shard["__tp_size__"] = np.asarray(tp_size, np.int64)
             shard["__has_opt__"] = np.asarray(opt_state is not None)
             shard["__zero_stage__"] = np.asarray(zero_stage, np.int64)
+            shard["__layout__"] = np.asarray(layout.to_json())
             path = os.path.join(
                 save_dir, f"tprank-{rank}_iter-{step}_loss-{avg_loss:.4f}.npz")
             # Atomic publish: a hard kill mid-write (preemption grace

@@ -54,7 +54,9 @@ def _forensics():
 
 # metric field -> direction ("up" = bigger is better). `value` resolves
 # per-unit below. Tolerances are fractions of the baseline.
-LOWER_BETTER_UNITS = ("ms/step", "ms/step (analytic)")
+# "ms" is the reshard record (bench --reshard): its headline value IS a
+# wall latency, so `value` gates downward like reshard_ms.
+LOWER_BETTER_UNITS = ("ms/step", "ms/step (analytic)", "ms")
 THROUGHPUT_FIELDS = ("value", "vs_baseline", "paged_vs_slot",
                      "accepted_tokens_per_dispatch",
                      # serving fleet (ISSUE 19): the fleet headline, the
@@ -69,13 +71,21 @@ THROUGHPUT_FIELDS = ("value", "vs_baseline", "paged_vs_slot",
 LATENCY_FIELDS = ("ttft_ms_p95", "tpot_ms_p95", "prefill_ms_per_token",
                   # fleet (ISSUE 19): a grown page-stream tail or router
                   # hop is a regression whatever tokens/s measured
-                  "transfer_ms_p95", "dispatch_ms_p95")
+                  "transfer_ms_p95", "dispatch_ms_p95",
+                  # reshard (ISSUE 20): elastic-restart downtime is this
+                  # wall — a grown reshard is lost serving time
+                  "reshard_ms")
 # analytic decode-dispatch HBM traffic (ISSUE 14): strictly directional —
 # a serving record whose per-step bytes GREW vs the trajectory regressed
 # the decode roofline (e.g. the pallas arm silently fell back to gather,
 # or the gather view grew — at cp>1 these are PER-CHIP bytes, ~1/cp of
 # the cp=1 pool), whatever tokens/s happened to measure
-BYTES_FIELDS = ("decode_hbm_bytes_per_step",)
+BYTES_FIELDS = ("decode_hbm_bytes_per_step",
+                # reshard (ISSUE 20): the minimal-transfer planner's whole
+                # point — a record that MOVED more bytes for the same
+                # src->dst pair means the plan degraded (e.g. a leaf fell
+                # off the copy fast-path), whatever the wall clock did
+                "reshard_bytes_moved")
 # MEASURED attribution (ISSUE 15): when both records carry a
 # measured_vs_analytic reconcile (bench --profile_every / the breakdown
 # --capture_profile), the measured per-step device ms and the measured
@@ -168,6 +178,13 @@ def metric_checks(fresh, base, tol_pct, tol_latency_pct):
         fields.append(("attribution.comm.exposed_ms", "down",
                        tol_latency_pct))
         fields.append(("comm.exposed_ms", "down", tol_latency_pct))
+        # the reshard record rides this branch (unit "ms"): its
+        # dedicated latency/bytes fields still gate directionally
+        # (absent fields skip visibly, as everywhere)
+        for f in LATENCY_FIELDS:
+            fields.append((f, "down", tol_latency_pct))
+        for f in BYTES_FIELDS:
+            fields.append((f, "down", tol_latency_pct))
     else:
         for f in THROUGHPUT_FIELDS:
             fields.append((f, "up", tol_pct))

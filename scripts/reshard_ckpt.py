@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""reshard_ckpt — offline any-layout→any-layout checkpoint reshard.
+
+Source checkpoint (stamped npz, legacy npz, or a reference .pth rank
+span) + target layout flags → a new `validate_checkpoint`-clean shard
+set at the target tp width, stamped with the target layout. Leaves
+stream one at a time (reshard/apply.py): peak host bytes stay bounded
+by the largest single leaf, never the tree.
+
+Usage:
+    # dp2xtp4 ZeRO-3 training ckpt -> tp2 serving shard set
+    python scripts/reshard_ckpt.py --src ckpts --dst ckpts_tp2 \
+        --tp 2 --model flagship-45m
+    # tp4 -> dp2xtp2 restart layout (zero stage rides the stamp)
+    python scripts/reshard_ckpt.py --src ckpts --dst ckpts_el \
+        --tp 2 --dp 2 --zero 1 --model flagship-45m
+
+One JSON record lands on stdout (plan op counts, bytes moved, wall ms,
+peak host bytes — run_stamp'd, the bench/serve convention); the plan
+summary prints human-readable on stderr.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--src", required=True,
+                   help="source checkpoint dir (tprank-*.npz or, with "
+                        "--ext pth, a reference .pth rank span)")
+    p.add_argument("--dst", required=True,
+                   help="output dir for the resharded shard set")
+    p.add_argument("--iter", type=int, default=None,
+                   help="iteration to reshard (default: latest in --src)")
+    p.add_argument("--tp", type=int, required=True,
+                   help="target tensor-parallel width (shard file count)")
+    p.add_argument("--dp", type=int, default=1,
+                   help="target data-parallel width (stamped for the "
+                        "loader's ZeRO ownership; files hold globals)")
+    p.add_argument("--zero", type=int, default=0, choices=(0, 1, 2, 3),
+                   help="target ZeRO stage (stamped into the new layout)")
+    p.add_argument("--ext", choices=("npz", "pth"), default="npz",
+                   help="source format (pth = legacy reference span, "
+                        "bridged through interop)")
+    p.add_argument("--model", default=None,
+                   help="model preset — REQUIRED for legacy sources "
+                        "(no __layout__ stamp): supplies the spec tree "
+                        "the layout is inferred onto")
+    p.add_argument("--plan_only", action="store_true",
+                   help="print the plan summary and exit without writing")
+    args = p.parse_args(argv)
+    if args.tp < 1 or args.dp < 1:
+        p.error("--tp/--dp must be >= 1")
+    return args
+
+
+def main(argv=None) -> dict:
+    args = parse_args(argv)
+
+    from distributed_pytorch_from_scratch_tpu.obs.runindex import run_stamp
+    from distributed_pytorch_from_scratch_tpu.reshard import (
+        HostMeter, make_layout, plan_checkpoint, reshard_checkpoint)
+    from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+        latest_step)
+
+    step = args.iter
+    if step is None:
+        if args.ext == "pth":
+            raise SystemExit("--ext pth needs an explicit --iter (only "
+                             "npz checkpoints index by latest_step)")
+        step = latest_step(args.src)
+        if step is None:
+            raise SystemExit(f"no checkpoints found in {args.src}")
+
+    specs = cfg = None
+    dst_specs = None
+    if args.model:
+        from distributed_pytorch_from_scratch_tpu.config import model_preset
+        from distributed_pytorch_from_scratch_tpu.models.transformer import (
+            Transformer)
+        cfg = model_preset(args.model)
+        specs = Transformer(cfg, tp_size=1).canonical_specs()
+        dst_specs = specs
+
+    echo = lambda *a: print(*a, file=sys.stderr)
+    mesh_axes = (("dp", args.dp), ("tp", args.tp))
+    if dst_specs is None:
+        # stamped source: the target reuses the stamped spec tree (the
+        # spec TREE is mesh-size-independent; only axis names matter)
+        from distributed_pytorch_from_scratch_tpu.reshard import (
+            resolve_source_layout)
+        src_layout, _ = resolve_source_layout(args.src, step, specs=specs,
+                                              ext=args.ext, echo=echo)
+        dst_specs = src_layout.specs
+    dst_layout = make_layout(mesh_axes, dst_specs, zero_stage=args.zero)
+
+    if args.plan_only:
+        plan, src_layout, legacy = plan_checkpoint(
+            args.src, step, dst_layout, specs=specs, ext=args.ext,
+            cfg=cfg, echo=echo)
+        rec = {"metric": "reshard_ckpt --plan_only", "value": 0,
+               "unit": "bytes moved (planned)", **plan.summary(),
+               "legacy": bool(legacy), "iter": step}
+        rec["value"] = rec["bytes_moved"]
+    else:
+        meter = HostMeter()
+        paths, plan, info = reshard_checkpoint(
+            args.src, step, args.dst, dst_layout, specs=specs,
+            ext=args.ext, cfg=cfg, meter=meter, echo=echo)
+        from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+            validate_checkpoint)
+        tp_out, _ = validate_checkpoint(args.dst, step)
+        assert tp_out == args.tp, (tp_out, args.tp)
+        rec = {"metric": "reshard_ckpt", "value": info["bytes_moved"],
+               "unit": "bytes moved", **info, "iter": step,
+               "files": len(paths)}
+        echo(f"reshard {info['src']} -> {info['dst']}: {len(paths)} "
+             f"shard(s) in {args.dst}, {info['bytes_moved']} bytes "
+             f"moved, peak host {info['peak_host_bytes']} B, "
+             f"{info['wall_ms']} ms")
+    rec.update(run_stamp(vars(args)))
+    print(json.dumps(rec))
+    return rec
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,14 @@ def parse_args(argv=None):
                    help="tp width of the --disagg prefill engine "
                         "(0 = same as --tp_size; the page stream "
                         "reshards heads)")
+    g.add_argument("--restart_tp", type=int, default=0,
+                   help="mid-run, restart one replica at this tp width: "
+                        "half the workload runs, the replica's live "
+                        "params reshard through the planner (reshard/), "
+                        "and the rest runs against the heterogeneous "
+                        "fleet (0 = off)")
+    g.add_argument("--restart_replica", default="r0",
+                   help="replica name --restart_tp restarts")
     g = p.add_argument_group("model")
     g.add_argument("--model", default="flagship-45m",
                    help="model preset (see config.model_preset)")
@@ -115,6 +123,11 @@ def parse_args(argv=None):
     if args.prefill_tp and not args.disagg:
         p.error("--prefill_tp is a --disagg knob (the router fleet's "
                 "replicas share --tp_size)")
+    if args.restart_tp and args.disagg:
+        p.error("--restart_tp restarts a router-fleet replica; it does "
+                "not compose with --disagg")
+    if args.restart_tp < 0:
+        p.error("--restart_tp must be >= 0")
     if not args.dry_run and not args.random_init and not args.ckpt_dir:
         p.error("need --ckpt_dir, or --random_init, or --dry_run")
     return args
@@ -140,7 +153,7 @@ def _load_params(args, model, mesh):
 
 
 def _build_engine(args, cfg, tp, process_index, writer, rt, telemetry,
-                  buf_len, prefill_only=False):
+                  buf_len, prefill_only=False, params=None):
     from distributed_pytorch_from_scratch_tpu.config import MeshConfig
     from distributed_pytorch_from_scratch_tpu.models.transformer import (
         Transformer)
@@ -152,7 +165,8 @@ def _build_engine(args, cfg, tp, process_index, writer, rt, telemetry,
 
     mesh = make_mesh(MeshConfig(dp=1, tp=tp))
     model = Transformer(cfg, tp_size=tp)
-    params = _load_params(args, model, mesh)
+    if params is None:
+        params = _load_params(args, model, mesh)
     classes = parse_slo_classes(args.class_mix) if args.class_mix else None
     return PagedEngine(
         model, mesh, params, num_slots=args.slots, buf_len=buf_len,
@@ -162,6 +176,56 @@ def _build_engine(args, cfg, tp, process_index, writer, rt, telemetry,
         slo_classes=classes, max_queue=args.max_queue, writer=writer,
         request_tracer=rt, telemetry=telemetry,
         prefill_only=prefill_only)
+
+
+def _reshard_restart(args, cfg, router, buf_len, obs_for):
+    """Restart --restart_replica at --restart_tp: plan the layout change,
+    reshard the LIVE replica's params per leaf (device→device — the
+    checkpoint never re-reads), attach the new engine under the old name.
+    Returns the reshard info dict the replica_restart event carries."""
+    import time
+
+    import jax
+
+    from distributed_pytorch_from_scratch_tpu.models.transformer import (
+        Transformer)
+    from distributed_pytorch_from_scratch_tpu.reshard import (
+        make_layout, plan_reshard, reshard_params)
+    from distributed_pytorch_from_scratch_tpu.runtime.mesh import make_mesh
+    from distributed_pytorch_from_scratch_tpu.config import MeshConfig
+    from distributed_pytorch_from_scratch_tpu.training.checkpoint import (
+        _flatten)
+
+    name, new_tp = args.restart_replica, args.restart_tp
+    old = router._engine(name)
+    old_tp = old.model.tp_size
+    if cfg.padded_vocab_size(old_tp) != cfg.padded_vocab_size(new_tp):
+        raise SystemExit(
+            f"--restart_tp {new_tp}: vocab padding differs between tp"
+            f"{old_tp} ({cfg.padded_vocab_size(old_tp)}) and tp{new_tp} "
+            f"({cfg.padded_vocab_size(new_tp)}) — the live trees have "
+            f"different shapes; restart from a checkpoint instead")
+    model = Transformer(cfg, tp_size=new_tp)
+    flat = _flatten(old._params_in, "param")
+    plan = plan_reshard(
+        sorted(flat), {k: tuple(v.shape) for k, v in flat.items()},
+        {k: v.dtype.itemsize for k, v in flat.items()},
+        make_layout((("tp", old_tp),), old.model.specs()),
+        make_layout((("tp", new_tp),), model.specs()))
+    t0 = time.perf_counter()
+    mesh = make_mesh(MeshConfig(dp=1, tp=new_tp))
+    params = reshard_params(old._params_in, mesh, model.specs())
+    jax.block_until_ready(params)
+    info = dict(plan.summary(),
+                wall_ms=round((time.perf_counter() - t0) * 1e3, 3))
+    w, rt, tel = obs_for(args.replicas + 1)
+    eng = _build_engine(args, cfg, new_tp, args.replicas + 1, w, rt, tel,
+                        buf_len, params=params)
+    router.replace_replica(name, eng, reshard=info)
+    print(f"replica {name} restarted at tp{new_tp}: "
+          f"{info['src']} -> {info['dst']}, {info['bytes_moved']} bytes, "
+          f"{info['wall_ms']} ms", file=sys.stderr)
+    return info
 
 
 def main(argv=None) -> dict:
@@ -265,9 +329,26 @@ def main(argv=None) -> dict:
                                  pool_weight=args.pool_weight,
                                  writer=wr, telemetry=telr,
                                  request_tracer=rtr)
-            summary = run_fleet_loadgen(router, requests)
-            summary["mode"] = "fleet"
-            metric = f"serve_fleet x{args.replicas}"
+            if args.restart_tp:
+                # two waves around the heterogeneous restart: the second
+                # wave runs against a fleet whose restarted replica is a
+                # DIFFERENT width, which is the thing being proven
+                half = max(1, len(requests) // 2)
+                wave_a = run_fleet_loadgen(router, requests[:half])
+                restart = _reshard_restart(args, cfg, router, buf_len,
+                                           obs_for)
+                summary = run_fleet_loadgen(router, requests[half:])
+                summary["completed"] = (summary.get("completed", 0)
+                                        + wave_a.get("completed", 0))
+                summary["wave_a_completed"] = wave_a.get("completed", 0)
+                summary["restart"] = restart
+                summary["mode"] = "fleet+restart"
+                metric = (f"serve_fleet x{args.replicas} "
+                          f"restart@tp{args.restart_tp}")
+            else:
+                summary = run_fleet_loadgen(router, requests)
+                summary["mode"] = "fleet"
+                metric = f"serve_fleet x{args.replicas}"
     finally:
         for tel in exporters:
             tel.close()

@@ -699,6 +699,26 @@ def manifest_failures(rdir):
     return rows
 
 
+def reshard_lines(rdir):
+    """One line per reshard_event (ISSUE 20): the layout lineage of this
+    run's params — an elastic resume, a fleet replica restarted at a new
+    width, or the offline reshard CLI — with the plan's movement facts."""
+    rows = []
+    for rel, rec in _iter_events(rdir, ("reshard_event",)):
+        ops = rec.get("plan_ops") or {}
+        ops_text = ", ".join(f"{k} x{v}" for k, v in sorted(ops.items()))
+        line = (f"- [{rel}] {rec.get('src_layout')} -> "
+                f"{rec.get('dst_layout')}: {rec.get('bytes_moved')} B "
+                f"moved ({ops_text or 'no movement'}) in "
+                f"{rec.get('wall_ms')} ms")
+        if rec.get("peak_host_bytes") is not None:
+            line += f", peak host {rec['peak_host_bytes']} B"
+        if rec.get("step") is not None:
+            line += f", iter {rec['step']}"
+        rows.append(line)
+    return rows
+
+
 def summarize(rdir):
     name = os.path.basename(os.path.normpath(rdir))
     out = [f"Collected from `{rdir}/` by `scripts/summarize_run.py` after "
@@ -783,6 +803,12 @@ def summarize(rdir):
         out.append("Run lineage (obs v6: the RunCard + nearest-baseline "
                    "diff — scripts/obs_diff.py for the full report):")
         out.extend(lineage)
+    resh = reshard_lines(rdir)
+    if resh:
+        out.append("")
+        out.append("Reshard lineage (reshard_event — which layout these "
+                   "params came from):")
+        out.extend(resh)
     drift = schema_warning_lines(rdir)
     if drift:
         out.append("")

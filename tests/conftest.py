@@ -236,6 +236,19 @@ CORE_LANE = {
     # committed-trajectory changepoint pin, the schema-v6 contracts, and
     # the --explain gate pair — all pure host, no compiles; the obs_diff
     # CLI matrix + the serve stamp e2e stay in the default lane
+    # reshard (ISSUE 20): the stamp round-trip, the file->file layout
+    # matrix (bit-identity + the peak-host-one-leaf bound), the planner's
+    # op/bytes pins, the loud inexpressible refusal, and the elastic
+    # file->device ZeRO-3 stream — all tiny-model; the subprocess elastic
+    # resume arm (slow) and the fleet width restart stay out of core
+    "test_reshard.py": [
+        "test_save_stamps_layout_and_resolves_exactly",
+        "test_reshard_checkpoint_bit_identical[",
+        "test_plan_op_pins_and_minimal_bytes",
+        "test_inexpressible_layout_refuses_loudly",
+        "test_stream_load_elastic_zero3_bit_identical_and_bounded",
+        "test_gate_treats_reshard_record_as_latency",
+    ],
     "test_forensics.py": [
         "test_run_card_pins_fixture_run_a",
         "test_outage_classifier_is_shared_with_gate",

@@ -1,0 +1,16 @@
+"""BAD: a reshard path materialises the whole tree on host.
+
+The streamed reshard discipline (reshard/apply.py): leaves cross the
+host one at a time, peak host bytes bounded by the largest single leaf.
+This helper gathers the ENTIRE device tree in one call, then loads
+every shard member eagerly — both whole-tree materialisations.
+"""
+
+import jax
+import numpy as np
+
+
+def reshard_to_host(tree, shard_path):
+    host = jax.device_get(tree)           # whole tree, one call
+    shards = dict(np.load(shard_path))    # every member, eagerly
+    return host, shards

@@ -260,7 +260,7 @@ def test_changepoint_quiet_on_flat_and_short_series():
 def test_schema_v6_forensics_contracts():
     """The version and both forensics tags' required fields are pinned,
     and real index/diff output round-trips through JSON + validates."""
-    assert EVENT_SCHEMA_VERSION == 6
+    assert EVENT_SCHEMA_VERSION == 7  # v7 added the reshard_event family
     assert EVENT_REQUIRED["run_card"] == \
         ("run", "kind", "outage", "baseline_eligible")
     assert EVENT_REQUIRED["run_diff"] == \

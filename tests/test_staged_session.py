@@ -48,7 +48,11 @@ R5 = os.path.join(REPO, "runs", "r5")
 # r20 the serving fleet: the live 2-replica router arm + its
 # single-replica baseline, the disaggregated prefill->decode arms
 # (native + int8 wire), the four-arm bench --fleet A/B, and the
-# int8-vs-native fleet regression-gate line)
+# int8-vs-native fleet regression-gate line,
+# r21 elastic reshard: the tp4 training artifact, the offline
+# plan-then-reshard to tp2 + serving it, the elastic dp2xtp2 --resume
+# arm off the tp4 checkpoint, the fleet width-restart arm, and the
+# bench --reshard pair with its regression-gate line)
 SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r7"),
                             os.path.join(REPO, "runs", "r8"),
@@ -63,7 +67,8 @@ SESSION_DIRS = [d for d in (R5, os.path.join(REPO, "runs", "r6"),
                             os.path.join(REPO, "runs", "r17"),
                             os.path.join(REPO, "runs", "r18"),
                             os.path.join(REPO, "runs", "r19"),
-                            os.path.join(REPO, "runs", "r20"))
+                            os.path.join(REPO, "runs", "r20"),
+                            os.path.join(REPO, "runs", "r21"))
                 if os.path.isdir(d)]
 SESSION_SCRIPTS = [os.path.join(d, n)
                    for d in SESSION_DIRS
@@ -208,7 +213,7 @@ def validate(argv):
         name = os.path.basename(prog)[:-3]
         if name in ("tpu_checks", "make_image_corpus", "tune_flash_blocks",
                     "check_bench_regression", "graftcheck", "obs_top",
-                    "obs_diff", "serve_fleet"):
+                    "obs_diff", "serve_fleet", "reshard_ckpt"):
             mod = _load_script(name)
             return _parse_with(mod.parse_args, rest)
         if name == "run_step":
